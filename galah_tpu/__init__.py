@@ -1,0 +1,14 @@
+"""galah-tpu: TPU-native genome dereplication.
+
+A brand-new JAX/XLA framework with the capabilities of AroneyS/galah
+(reference surveyed in SURVEY.md): cluster genomes by ANI with a two-stage
+precluster -> exact-ANI pipeline and pick one quality-ranked representative
+per cluster. The compute path is TPU-first: vectorized k-mer hashing,
+bottom-k / FracMinHash sketching, and tiled all-pairs similarity sharded
+over a device mesh, instead of the reference's rayon thread pool and
+external C++ binaries.
+"""
+
+__version__ = "0.1.0"
+
+from galah_tpu.config import ClusterConfig, Defaults  # noqa: F401

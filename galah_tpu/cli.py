@@ -1,0 +1,40 @@
+"""Command-line interface: `galah-tpu cluster` / `galah-tpu cluster-validate`.
+
+Mirrors the reference CLI surface (reference: src/main.rs:53-118,
+src/cluster_argument_parsing.rs:1265-1375). Subcommands land incrementally;
+unimplemented ones exit with a clear message rather than a traceback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import galah_tpu
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="galah-tpu",
+        description="TPU-native genome dereplication (ANI clustering with "
+                    "quality-ranked representatives)")
+    parser.add_argument("--version", action="version",
+                        version=galah_tpu.__version__)
+    sub = parser.add_subparsers(dest="subcommand")
+    sub.add_parser("cluster", add_help=False)
+    sub.add_parser("cluster-validate", add_help=False)
+    return parser
+
+
+def main(argv=None) -> int:
+    args, _rest = build_parser().parse_known_args(argv)
+    if args.subcommand is None:
+        build_parser().print_help()
+        return 1
+    print(f"galah-tpu {args.subcommand}: not implemented yet in this build",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""Central configuration and compile-time defaults.
+
+Mirrors the reference's defaults block (reference: src/lib.rs:39-47) — the
+code defaults are authoritative (the reference README's 99/95 text is stale,
+see BASELINE.md). Sketch parameters mirror the finch/skani parameter sets the
+reference hard-codes (reference: src/finch.rs:33-45, src/skani.rs:131-163).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+
+class Defaults:
+    """Compile-time defaults (reference: src/lib.rs:39-47)."""
+
+    ALIGNED_FRACTION = 0.15          # --min-aligned-fraction 15%
+    FRAGMENT_LENGTH = 3000           # --fragment-length
+    ANI = 95.0                       # --ani (percent)
+    PRETHRESHOLD_ANI = 90.0          # --precluster-ani (percent)
+    QUALITY_FORMULA = "Parks2020_reduced"
+    PRECLUSTER_METHOD = "skani"      # choices: skani, finch, dashing
+    CLUSTER_METHOD = "skani"         # choices: skani, fastani
+
+    # MinHash (finch-equivalent) sketch params (reference: src/finch.rs:33-45)
+    MINHASH_KMER = 21
+    MINHASH_SKETCH_SIZE = 1000
+    MINHASH_SEED = 0
+
+    # FracMinHash (skani-equivalent) params (reference: src/skani.rs:131-163)
+    SKANI_C = 125                    # FracMinHash compression factor
+    SKANI_MARKER_C = 1000            # marker sketch compression
+    SKANI_KMER = 15
+    SKANI_SCREEN_CONTAINMENT = 0.80  # candidate screening (src/skani.rs:59)
+
+    # Quality-filter defaults: no filtering unless quality input given
+    MIN_COMPLETENESS = None
+    MAX_CONTAMINATION = None
+
+
+PRECLUSTER_METHODS = ("skani", "finch", "dashing")
+CLUSTER_METHODS = ("skani", "fastani")
+QUALITY_FORMULAS = (
+    "Parks2020_reduced",
+    "completeness-4contamination",
+    "completeness-5contamination",
+    "dRep",
+)
+
+
+def parse_percentage(value: float, name: str = "value") -> float:
+    """Normalize a percentage argument to a fraction in (0, 1].
+
+    Accepts either 1-100 (percent) or 0-1 (fraction), like the reference's
+    parse_percentage (reference: src/cluster_argument_parsing.rs:1160-1182).
+    """
+    v = float(value)
+    if v > 1.0:
+        v = v / 100.0
+    if not (0.0 < v <= 1.0):
+        raise ValueError(f"{name} must be within (0, 100], got {value}")
+    return v
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """Everything `galah-tpu cluster` needs; the host-side config object.
+
+    Thresholds are stored as *fractions* (0-1); backends that want percent
+    units multiply by 100 themselves.
+    """
+
+    genome_paths: Sequence[str] = ()
+    ani: float = Defaults.ANI / 100.0
+    precluster_ani: float = Defaults.PRETHRESHOLD_ANI / 100.0
+    min_aligned_fraction: float = Defaults.ALIGNED_FRACTION
+    fragment_length: int = Defaults.FRAGMENT_LENGTH
+    precluster_method: str = Defaults.PRECLUSTER_METHOD
+    cluster_method: str = Defaults.CLUSTER_METHOD
+    quality_formula: str = Defaults.QUALITY_FORMULA
+    min_completeness: Optional[float] = None   # fraction
+    max_contamination: Optional[float] = None  # fraction
+    checkm_tab_table: Optional[str] = None
+    checkm2_quality_report: Optional[str] = None
+    genome_info: Optional[str] = None
+    threads: int = 1
+    # outputs
+    output_cluster_definition: Optional[str] = None
+    output_representative_fasta_directory: Optional[str] = None
+    output_representative_fasta_directory_copy: Optional[str] = None
+    output_representative_list: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.precluster_method not in PRECLUSTER_METHODS:
+            raise ValueError(
+                f"unknown precluster method {self.precluster_method!r}; "
+                f"choices: {PRECLUSTER_METHODS}")
+        if self.cluster_method not in CLUSTER_METHODS:
+            raise ValueError(
+                f"unknown cluster method {self.cluster_method!r}; "
+                f"choices: {CLUSTER_METHODS}")
+        if self.quality_formula not in QUALITY_FORMULAS:
+            raise ValueError(
+                f"unknown quality formula {self.quality_formula!r}; "
+                f"choices: {QUALITY_FORMULAS}")

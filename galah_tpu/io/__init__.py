@@ -1,0 +1,1 @@
+from galah_tpu.io.fasta import Genome, GenomeStats, read_genome  # noqa: F401

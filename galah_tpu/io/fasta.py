@@ -1,0 +1,158 @@
+"""FASTA ingestion: file -> 2-bit codes + validity mask + contig offsets.
+
+This is the framework's needletail analog (reference: src/genome_stats.rs:1-51
+consumes needletail's streaming FASTA parse). The device-facing contract is a
+flat uint8 code array (A=0 C=1 G=2 T=3, case-insensitive), a validity mask
+(False where the base is ambiguous, e.g. N), and contig offsets — static-shape
+friendly inputs for the JAX k-mer kernels.
+
+A C++ fast path (galah_tpu.io._cingest, built from csrc/ingest.c) parses,
+packs, and computes stats in one pass; the numpy implementation below is the
+always-available fallback and the semantic reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+from typing import List, Optional
+
+import numpy as np
+
+# ASCII -> 2-bit code; 255 marks ambiguous/non-ACGT.
+_CODE_LUT = np.full(256, 255, dtype=np.uint8)
+for _i, _b in enumerate(b"ACGT"):
+    _CODE_LUT[_b] = _i
+    _CODE_LUT[_b + 32] = _i  # lowercase
+
+
+@dataclasses.dataclass
+class GenomeStats:
+    """Assembly stats (reference: src/genome_stats.rs:11-51)."""
+
+    num_contigs: int
+    num_ambiguous_bases: int
+    n50: int
+
+
+@dataclasses.dataclass
+class Genome:
+    """A parsed genome ready for device sketching."""
+
+    path: str
+    codes: np.ndarray          # uint8 [total_len], 0-3 valid, 255 ambiguous
+    contig_offsets: np.ndarray  # int64 [num_contigs + 1]
+    stats: GenomeStats
+
+    @property
+    def length(self) -> int:
+        return int(self.codes.shape[0])
+
+
+def _open_maybe_gzip(path: str):
+    with open(path, "rb") as fh:
+        magic = fh.read(2)
+    if magic == b"\x1f\x8b":
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _compute_n50(lengths: np.ndarray) -> int:
+    """N50: length L such that contigs >= L cover half the assembly.
+
+    Matches the reference's accumulate-from-longest definition
+    (reference: src/genome_stats.rs:53-59 via the golden 8289 test).
+    """
+    if lengths.size == 0:
+        return 0
+    s = np.sort(lengths)[::-1]
+    csum = np.cumsum(s)
+    half = csum[-1] / 2.0
+    idx = int(np.searchsorted(csum, half))
+    return int(s[idx])
+
+
+def read_genome(path: str, with_codes: bool = True) -> Genome:
+    """Parse a (possibly gzipped) FASTA into codes + offsets + stats.
+
+    Stats semantics match the reference goldens (reference:
+    src/genome_stats.rs:61-87): num_contigs counts records, ambiguous counts
+    every base that is not ACGT/acgt, N50 from descending cumulative sum.
+    """
+    try:
+        from galah_tpu.io import _cingest  # C fast path, optional
+    except Exception:
+        _cingest = None
+    if _cingest is not None:
+        try:
+            return _read_genome_c(_cingest, path, with_codes)
+        except Exception:
+            pass  # fall back to the numpy path on any C-side failure
+
+    contig_seqs: List[np.ndarray] = []
+    cur_parts: List[bytes] = []
+    n_contigs = 0
+    with _open_maybe_gzip(path) as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line:
+                continue
+            if line.startswith(b">"):
+                if n_contigs > 0:
+                    contig_seqs.append(
+                        np.frombuffer(b"".join(cur_parts), dtype=np.uint8))
+                cur_parts = []
+                n_contigs += 1
+            elif n_contigs > 0:
+                # sequence lines before the first '>' header are not part
+                # of any record; drop them like a streaming FASTA parser
+                cur_parts.append(line)
+        if n_contigs > 0:
+            contig_seqs.append(
+                np.frombuffer(b"".join(cur_parts), dtype=np.uint8))
+    if n_contigs == 0:
+        raise ValueError(f"no FASTA records found in {path}")
+
+    lengths = np.array([c.shape[0] for c in contig_seqs], dtype=np.int64)
+    offsets = np.zeros(n_contigs + 1, dtype=np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+
+    ascii_all = (np.concatenate(contig_seqs) if contig_seqs
+                 else np.zeros(0, dtype=np.uint8))
+    codes = _CODE_LUT[ascii_all]
+    num_ambiguous = int((codes == 255).sum())
+
+    stats = GenomeStats(
+        num_contigs=n_contigs,
+        num_ambiguous_bases=num_ambiguous,
+        n50=_compute_n50(lengths),
+    )
+    return Genome(
+        path=path,
+        codes=codes if with_codes else np.zeros(0, dtype=np.uint8),
+        contig_offsets=offsets,
+        stats=stats,
+    )
+
+
+def _read_genome_c(cingest, path: str, with_codes: bool) -> Genome:
+    codes, offsets, num_ambiguous, n50 = cingest.read_fasta(path)
+    n_contigs = int(offsets.shape[0]) - 1
+    if n_contigs <= 0:
+        raise ValueError(f"no FASTA records found in {path}")
+    stats = GenomeStats(
+        num_contigs=n_contigs,
+        num_ambiguous_bases=int(num_ambiguous),
+        n50=int(n50),
+    )
+    return Genome(
+        path=path,
+        codes=codes if with_codes else np.zeros(0, dtype=np.uint8),
+        contig_offsets=offsets.astype(np.int64),
+        stats=stats,
+    )
+
+
+def calculate_genome_stats(path: str) -> GenomeStats:
+    """Stats-only entry point (reference: src/genome_stats.rs:11)."""
+    return read_genome(path, with_codes=False).stats

@@ -1,0 +1,6 @@
+"""Shared numeric constants for the sketch/pairwise kernels."""
+
+# uint64 sentinel meaning "no hash here" (padding / invalid k-mer). Shared
+# by the JAX kernels (ops/hashing.py re-exports it as a jnp scalar) and all
+# host-side padding code.
+SENTINEL = 0xFFFFFFFFFFFFFFFF

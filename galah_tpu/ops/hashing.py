@@ -1,0 +1,155 @@
+"""JAX kernels: canonical k-mer extraction + MurmurHash3 x64_128 (h1).
+
+Device-side twin of ops/murmur3_np.py / ops/minhash_np.py, verified
+bit-exact against them in tests/test_minhash.py. All shapes are static; a
+genome is processed as fixed-size chunks so XLA compiles once per chunk
+size. uint64 arithmetic wraps (XLA emulates 64-bit integers with u32 pairs
+on TPU; if profiling shows hashing hot, the planned optimization is a
+Pallas u32-pair kernel).
+
+Hash semantics mirror the reference's finch backend contract
+(reference: src/finch.rs:33-47): canonical (lexicographic min of forward /
+reverse-complement) k-mer ASCII bytes, murmur3 x64_128 seed 0, low u64.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Ensure 64-bit integer support; all dtypes in this package are explicit so
+# enabling x64 does not change any float widths we use.
+jax.config.update("jax_enable_x64", True)
+
+from galah_tpu.ops.constants import SENTINEL
+
+_C1 = jnp.uint64(0x87C37B91114253D5)
+_C2 = jnp.uint64(0x4CF5AD432745937F)
+
+HASH_SENTINEL = jnp.uint64(SENTINEL)  # "no k-mer here"
+
+_ASCII = jnp.array([65, 67, 71, 84], dtype=jnp.uint8)  # ACGT
+
+
+def _rotl64(x: jax.Array, r: int) -> jax.Array:
+    return (x << jnp.uint64(r)) | (x >> jnp.uint64(64 - r))
+
+
+def _fmix64(x: jax.Array) -> jax.Array:
+    x = x ^ (x >> jnp.uint64(33))
+    x = x * jnp.uint64(0xFF51AFD7ED558CCD)
+    x = x ^ (x >> jnp.uint64(33))
+    x = x * jnp.uint64(0xC4CEB9FE1A85EC53)
+    x = x ^ (x >> jnp.uint64(33))
+    return x
+
+
+def murmur3_x64_128_h1(keys: jax.Array, seed: int = 0) -> jax.Array:
+    """h1 of murmur3 x64_128 over uint8 rows, shape (n, L) -> (n,) uint64.
+
+    L is a static (trace-time) constant; the byte loops unroll at trace
+    time into pure vector ops over the n axis.
+    """
+    n, length = keys.shape
+    h1 = jnp.full((n,), jnp.uint64(seed))
+    h2 = jnp.full((n,), jnp.uint64(seed))
+
+    nblocks = length // 16
+    for blk in range(nblocks):
+        base = blk * 16
+        k1 = jnp.zeros((n,), jnp.uint64)
+        k2 = jnp.zeros((n,), jnp.uint64)
+        for b in range(8):
+            k1 = k1 | (keys[:, base + b].astype(jnp.uint64)
+                       << jnp.uint64(8 * b))
+            k2 = k2 | (keys[:, base + 8 + b].astype(jnp.uint64)
+                       << jnp.uint64(8 * b))
+        k1 = _rotl64(k1 * _C1, 31) * _C2
+        h1 = h1 ^ k1
+        h1 = _rotl64(h1, 27) + h2
+        h1 = h1 * jnp.uint64(5) + jnp.uint64(0x52DCE729)
+        k2 = _rotl64(k2 * _C2, 33) * _C1
+        h2 = h2 ^ k2
+        h2 = _rotl64(h2, 31) + h1
+        h2 = h2 * jnp.uint64(5) + jnp.uint64(0x38495AB5)
+
+    rem = length & 15
+    base = nblocks * 16
+    if rem > 8:
+        k2 = jnp.zeros((n,), jnp.uint64)
+        for b in range(8, rem):
+            k2 = k2 | (keys[:, base + b].astype(jnp.uint64)
+                       << jnp.uint64(8 * (b - 8)))
+        k2 = _rotl64(k2 * _C2, 33) * _C1
+        h2 = h2 ^ k2
+    if rem > 0:
+        k1 = jnp.zeros((n,), jnp.uint64)
+        for b in range(min(rem, 8)):
+            k1 = k1 | (keys[:, base + b].astype(jnp.uint64)
+                       << jnp.uint64(8 * b))
+        k1 = _rotl64(k1 * _C1, 31) * _C2
+        h1 = h1 ^ k1
+
+    h1 = h1 ^ jnp.uint64(length)
+    h2 = h2 ^ jnp.uint64(length)
+    h1 = h1 + h2
+    h2 = h2 + h1
+    h1 = _fmix64(h1)
+    h2 = _fmix64(h2)
+    h1 = h1 + h2
+    return h1
+
+
+@functools.partial(jax.jit, static_argnames=("k", "seed"))
+def canonical_kmer_hashes_chunk(
+    codes: jax.Array,       # uint8 (C,), 0-3 valid, 255 ambiguous/pad
+    boundary: jax.Array,    # int32 (C,), contig id per position
+    k: int = 21,
+    seed: int = 0,
+) -> jax.Array:
+    """Hash every canonical k-mer starting in this chunk -> (C-k+1,) uint64.
+
+    Positions whose window contains an ambiguous base or crosses a contig
+    boundary produce HASH_SENTINEL. The caller overlaps consecutive chunks
+    by k-1 positions so every k-mer is seen exactly once.
+    """
+    n_win = codes.shape[0] - k + 1
+    # (n_win, k) windows via k static slices — XLA fuses these gathers.
+    win = jnp.stack([codes[i:i + n_win] for i in range(k)], axis=1)
+    valid = jnp.all(win != jnp.uint8(255), axis=1)
+    valid = valid & (boundary[:n_win] == boundary[k - 1:k - 1 + n_win])
+
+    # Pack forward / reverse-complement for the lexicographic-min compare
+    # (code order A<C<G<T matches ASCII order, so integer compare == string
+    # compare at fixed length).
+    shifts = jnp.uint64(2) * jnp.arange(k - 1, -1, -1, dtype=jnp.uint64)
+    safe = jnp.where(valid[:, None], win, jnp.uint8(0))
+    w64 = safe.astype(jnp.uint64)
+    fwd = jnp.sum(w64 << shifts, axis=1, dtype=jnp.uint64)
+    rc = (jnp.uint8(3) - safe)[:, ::-1]
+    rev = jnp.sum(rc.astype(jnp.uint64) << shifts, axis=1, dtype=jnp.uint64)
+    use_fwd = fwd <= rev
+
+    canon = jnp.where(use_fwd[:, None], safe, rc)
+    ascii_kmers = _ASCII[canon]
+    hashes = murmur3_x64_128_h1(ascii_kmers, seed=seed)
+    return jnp.where(valid, hashes, HASH_SENTINEL)
+
+
+@functools.partial(jax.jit, static_argnames=("sketch_size",))
+def bottom_k_update(
+    running: jax.Array,  # uint64 (sketch_size,) sorted asc, SENTINEL-padded
+    hashes: jax.Array,   # uint64 (m,) chunk hashes, SENTINEL where invalid
+    sketch_size: int = 1000,
+) -> jax.Array:
+    """Fold a chunk of hashes into a running bottom-k distinct sketch."""
+    allh = jnp.concatenate([running, hashes])
+    allh = jnp.sort(allh)
+    # Mark duplicates (keep first occurrence), then re-sort and truncate.
+    dup = jnp.concatenate(
+        [jnp.zeros((1,), bool), allh[1:] == allh[:-1]])
+    allh = jnp.where(dup, HASH_SENTINEL, allh)
+    allh = jnp.sort(allh)
+    return allh[:sketch_size]

@@ -1,0 +1,84 @@
+"""Device MinHash sketching: chunked k-mer hashing + running bottom-k.
+
+Produces bit-identical sketches to ops/minhash_np.py (the numpy semantic
+reference), validated in tests/test_minhash.py, but runs the hash + top-k
+work on the accelerator. Genomes are processed in fixed-size chunks (with
+k-1 overlap) so XLA compiles one kernel per (chunk, k) and reuses it across
+all genomes and contigs regardless of length.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from galah_tpu.config import Defaults
+from galah_tpu.io.fasta import Genome
+from galah_tpu.ops import hashing
+from galah_tpu.ops.constants import SENTINEL
+from galah_tpu.ops.minhash_np import MinHashSketch
+
+# 1 Mi positions per chunk: multi-Mbp genomes take a handful of kernel
+# launches; the (C, k) window tensor is ~21 MiB uint8.
+DEFAULT_CHUNK = 1 << 20
+
+
+def sketch_genome_device(
+    genome: Genome,
+    sketch_size: int = Defaults.MINHASH_SKETCH_SIZE,
+    k: int = Defaults.MINHASH_KMER,
+    seed: int = Defaults.MINHASH_SEED,
+    chunk: int = DEFAULT_CHUNK,
+) -> MinHashSketch:
+    """Bottom-k distinct canonical k-mer sketch, computed on device."""
+    if chunk <= k - 1:
+        raise ValueError(f"chunk ({chunk}) must exceed k-1 ({k - 1})")
+    codes = genome.codes
+    n = codes.shape[0]
+    # Contig id per position, so windows spanning contigs are masked out.
+    boundary = np.zeros(n, dtype=np.int32)
+    offs = genome.contig_offsets
+    if offs.shape[0] > 2:
+        boundary = (
+            np.searchsorted(offs, np.arange(n), side="right").astype(np.int32))
+
+    running = jnp.full((sketch_size,), hashing.HASH_SENTINEL)
+    step = chunk - (k - 1)
+    pos = 0
+    while pos < max(n - k + 1, 1) or pos == 0:
+        end = min(pos + chunk, n)
+        c = np.full(chunk, 255, dtype=np.uint8)
+        b = np.full(chunk, -1, dtype=np.int32)
+        c[: end - pos] = codes[pos:end]
+        b[: end - pos] = boundary[pos:end]
+        hashes = hashing.canonical_kmer_hashes_chunk(
+            jnp.asarray(c), jnp.asarray(b), k=k, seed=seed)
+        running = hashing.bottom_k_update(
+            running, hashes, sketch_size=sketch_size)
+        pos += step
+        if end >= n:
+            break
+
+    out = np.asarray(running)
+    out = out[out != np.uint64(SENTINEL)]
+    return MinHashSketch(hashes=out, sketch_size=sketch_size, kmer=k)
+
+
+def sketch_matrix(
+    sketches: Sequence[MinHashSketch],
+    sketch_size: int = Defaults.MINHASH_SKETCH_SIZE,
+) -> np.ndarray:
+    """Stack sketches into a SENTINEL-padded (N, sketch_size) uint64 matrix.
+
+    This is the dense device-facing layout for the all-pairs kernel; rows
+    sorted ascending with trailing sentinels for genomes that yielded fewer
+    than sketch_size distinct k-mers.
+    """
+    n = len(sketches)
+    mat = np.full((n, sketch_size), np.uint64(SENTINEL), dtype=np.uint64)
+    for i, s in enumerate(sketches):
+        m = min(s.size, sketch_size)
+        mat[i, :m] = s.hashes[:m]
+    return mat

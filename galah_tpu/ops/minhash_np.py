@@ -1,0 +1,146 @@
+"""Bottom-k MinHash sketching + Mash distance — numpy reference path.
+
+Semantics to match the reference's finch backend (reference:
+src/finch.rs:26-73): canonical k-mers (lexicographic min of forward and
+reverse complement), MurmurHash3 x64_128 h1 with seed 0, bottom-k sketch of
+the 1000 smallest *distinct* hashes, Mash distance
+d = -ln(2j/(1+j))/k from the merged-bottom-k Jaccard estimate, ANI = 1 - d.
+
+Golden oracle: set1/1mbp.fna vs set1/500kb.fna -> ANI 0.9808188
+(reference: src/finch.rs:96).
+
+K-mers spanning a contig boundary or containing an ambiguous base are
+skipped, matching needletail's valid-kmer iteration that finch consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import numpy as np
+
+from galah_tpu.config import Defaults
+from galah_tpu.io.fasta import Genome
+from galah_tpu.ops.murmur3_np import murmur3_x64_128_h1
+
+# ASCII for code 0..3
+_ASCII = np.frombuffer(b"ACGT", dtype=np.uint8)
+
+
+@dataclasses.dataclass
+class MinHashSketch:
+    """Sorted ascending distinct bottom-k hash sketch of one genome."""
+
+    hashes: np.ndarray  # uint64 [<= sketch_size], sorted ascending
+    sketch_size: int
+    kmer: int
+
+    @property
+    def size(self) -> int:
+        return int(self.hashes.shape[0])
+
+
+def canonical_kmer_hashes(
+    genome: Genome,
+    k: int = Defaults.MINHASH_KMER,
+    seed: int = Defaults.MINHASH_SEED,
+) -> np.ndarray:
+    """All valid canonical k-mer hashes of a genome (with duplicates)."""
+    codes = genome.codes
+    n = codes.shape[0]
+    if n < k:
+        return np.zeros(0, dtype=np.uint64)
+
+    # Sliding windows of codes: (n-k+1, k)
+    win = np.lib.stride_tricks.sliding_window_view(codes, k)
+    valid = (win != 255).all(axis=1)
+
+    # Exclude windows that span a contig boundary.
+    if genome.contig_offsets.shape[0] > 2:
+        starts = np.arange(n - k + 1)
+        # contig id of window start and of window end must agree
+        cid_start = np.searchsorted(genome.contig_offsets, starts,
+                                    side="right")
+        cid_end = np.searchsorted(genome.contig_offsets, starts + k - 1,
+                                  side="right")
+        valid &= cid_start == cid_end
+
+    win = win[valid]
+    if win.shape[0] == 0:
+        return np.zeros(0, dtype=np.uint64)
+
+    # Pack forward and reverse-complement into integers for lexicographic
+    # comparison (A<C<G<T holds in both code space and ASCII space, so the
+    # packed-integer compare equals the string compare).
+    shifts = (2 * np.arange(k - 1, -1, -1)).astype(np.uint64)
+    w64 = win.astype(np.uint64)
+    fwd = (w64 << shifts).sum(axis=1, dtype=np.uint64)
+    rc_codes = 3 - win[:, ::-1]
+    rev = (rc_codes.astype(np.uint64) << shifts).sum(axis=1, dtype=np.uint64)
+    use_fwd = fwd <= rev
+
+    canon = np.where(use_fwd[:, None], win, rc_codes)
+    ascii_kmers = _ASCII[canon]
+    return murmur3_x64_128_h1(ascii_kmers, seed=seed)
+
+
+def sketch_genome(
+    genome: Genome,
+    sketch_size: int = Defaults.MINHASH_SKETCH_SIZE,
+    k: int = Defaults.MINHASH_KMER,
+    seed: int = Defaults.MINHASH_SEED,
+) -> MinHashSketch:
+    """Bottom-k distinct-hash sketch (finch Mash-mode equivalent)."""
+    hashes = canonical_kmer_hashes(genome, k=k, seed=seed)
+    distinct = np.unique(hashes)  # sorted ascending
+    return MinHashSketch(
+        hashes=distinct[:sketch_size], sketch_size=sketch_size, kmer=k)
+
+
+def mash_jaccard(a: MinHashSketch, b: MinHashSketch) -> float:
+    """Merged-bottom-k Jaccard estimate (Mash/finch semantics).
+
+    Walk the two sorted sketches in merge order over the smallest
+    `sketch_size` distinct union hashes; j = shared / seen.
+    """
+    size = min(a.sketch_size, b.sketch_size)
+    ha, hb = a.hashes, b.hashes
+    i = j = common = total = 0
+    la, lb = len(ha), len(hb)
+    while i < la and j < lb and total < size:
+        if ha[i] < hb[j]:
+            i += 1
+        elif hb[j] < ha[i]:
+            j += 1
+        else:
+            common += 1
+            i += 1
+            j += 1
+        total += 1
+    while i < la and total < size:
+        i += 1
+        total += 1
+    while j < lb and total < size:
+        j += 1
+        total += 1
+    if total == 0:
+        return 0.0
+    return common / total
+
+
+def mash_ani(a: MinHashSketch, b: MinHashSketch) -> float:
+    """ANI = 1 - Mash distance (reference: src/finch.rs:56-64)."""
+    j = mash_jaccard(a, b)
+    if j <= 0.0:
+        return 0.0
+    k = a.kmer
+    d = -math.log(2.0 * j / (1.0 + j)) / k
+    return 1.0 - d
+
+
+def sketch_genomes(
+    genomes: Sequence[Genome], **kw
+) -> list[MinHashSketch]:
+    return [sketch_genome(g, **kw) for g in genomes]

@@ -1,0 +1,99 @@
+"""Vectorized MurmurHash3 x64_128 (h1) over fixed-length byte rows — numpy.
+
+This is the semantic reference for the JAX kernel in ops/hashing.py, and the
+host-side fallback. The reference's finch backend hashes canonical k-mer
+ASCII bytes with murmurhash3_x64_128 seed 0 and keeps the low u64
+(reference: src/finch.rs:33-47 parameterizes finch's sketcher; the hash
+itself lives in the finch crate, reproduced here from the MurmurHash3 spec).
+
+All arithmetic is uint64 wrap-around; numpy arrays wrap silently.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_C1 = np.uint64(0x87C37B91114253D5)
+_C2 = np.uint64(0x4CF5AD432745937F)
+
+
+def _rotl64(x: np.ndarray, r: int) -> np.ndarray:
+    r = np.uint64(r)
+    return (x << r) | (x >> (np.uint64(64) - r))
+
+
+def _fmix64(x: np.ndarray) -> np.ndarray:
+    x = x ^ (x >> np.uint64(33))
+    x = x * np.uint64(0xFF51AFD7ED558CCD)
+    x = x ^ (x >> np.uint64(33))
+    x = x * np.uint64(0xC4CEB9FE1A85EC53)
+    x = x ^ (x >> np.uint64(33))
+    return x
+
+
+def _le_u64(block: np.ndarray) -> np.ndarray:
+    """Little-endian uint64 from uint8 rows of shape (..., 8)."""
+    out = np.zeros(block.shape[:-1], dtype=np.uint64)
+    for b in range(8):
+        out |= block[..., b].astype(np.uint64) << np.uint64(8 * b)
+    return out
+
+
+def murmur3_x64_128_h1(keys: np.ndarray, seed: int = 0) -> np.ndarray:
+    """h1 of MurmurHash3_x64_128 for each row of `keys` (uint8, shape (n, L)).
+
+    Row length L is static (all keys same length), matching the fixed-k k-mer
+    use case. Returns uint64 array of shape (n,).
+    """
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    n, length = keys.shape
+    h1 = np.full(n, np.uint64(seed), dtype=np.uint64)
+    h2 = np.full(n, np.uint64(seed), dtype=np.uint64)
+
+    nblocks = length // 16
+    for blk in range(nblocks):
+        k1 = _le_u64(keys[:, blk * 16: blk * 16 + 8])
+        k2 = _le_u64(keys[:, blk * 16 + 8: blk * 16 + 16])
+        k1 = k1 * _C1
+        k1 = _rotl64(k1, 31)
+        k1 = k1 * _C2
+        h1 = h1 ^ k1
+        h1 = _rotl64(h1, 27)
+        h1 = h1 + h2
+        h1 = h1 * np.uint64(5) + np.uint64(0x52DCE729)
+        k2 = k2 * _C2
+        k2 = _rotl64(k2, 33)
+        k2 = k2 * _C1
+        h2 = h2 ^ k2
+        h2 = _rotl64(h2, 31)
+        h2 = h2 + h1
+        h2 = h2 * np.uint64(5) + np.uint64(0x38495AB5)
+
+    tail = keys[:, nblocks * 16:]
+    rem = length & 15
+    k1 = np.zeros(n, dtype=np.uint64)
+    k2 = np.zeros(n, dtype=np.uint64)
+    if rem > 8:
+        for b in range(rem - 1, 7, -1):
+            k2 = k2 ^ (tail[:, b].astype(np.uint64) << np.uint64(8 * (b - 8)))
+        k2 = k2 * _C2
+        k2 = _rotl64(k2, 33)
+        k2 = k2 * _C1
+        h2 = h2 ^ k2
+    if rem > 0:
+        for b in range(min(rem, 8) - 1, -1, -1):
+            k1 = k1 ^ (tail[:, b].astype(np.uint64) << np.uint64(8 * b))
+        k1 = k1 * _C1
+        k1 = _rotl64(k1, 31)
+        k1 = k1 * _C2
+        h1 = h1 ^ k1
+
+    h1 = h1 ^ np.uint64(length)
+    h2 = h2 ^ np.uint64(length)
+    h1 = h1 + h2
+    h2 = h2 + h1
+    h1 = _fmix64(h1)
+    h2 = _fmix64(h2)
+    h1 = h1 + h2
+    # h2 = h2 + h1 would complete the 128-bit digest; only h1 is consumed.
+    return h1

@@ -1,0 +1,23 @@
+"""Leveled logging, mirroring the reference's -v/-q semantics.
+
+Reference: bird_tool_utils::clap_utils::set_log_level as used from
+src/main.rs:17 and src/cluster_argument_parsing.rs:402.
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+def set_log_level(verbose: bool = False, quiet: bool = False) -> None:
+    level = logging.INFO
+    if verbose:
+        level = logging.DEBUG
+    if quiet:
+        level = logging.ERROR
+    logging.basicConfig(
+        level=level,
+        format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+        force=True,
+    )

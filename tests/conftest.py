@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device CPU mesh before JAX import.
+
+Mirrors SURVEY.md §4's third tier: multi-device semantics are tested on CPU
+via --xla_force_host_platform_device_count so no TPU (and no multi-chip
+hardware) is needed to exercise the sharded pairwise path.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# The axon sitecustomize pins JAX_PLATFORMS to the TPU backend at
+# interpreter startup; the config update below (before any jax use) wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pathlib
+
+import pytest
+
+REFERENCE_DATA = pathlib.Path("/root/reference/tests/data")
+
+
+@pytest.fixture(scope="session")
+def ref_data() -> pathlib.Path:
+    if not REFERENCE_DATA.is_dir():
+        pytest.skip("reference fixture data not available")
+    return REFERENCE_DATA

@@ -1,0 +1,49 @@
+"""FASTA ingestion and genome-stats goldens.
+
+Golden values come from the reference's inline tests
+(reference: src/genome_stats.rs:61-87).
+"""
+
+import numpy as np
+
+from galah_tpu.io import read_genome
+from galah_tpu.io.fasta import calculate_genome_stats
+
+
+def test_golden_stats_abisko4(ref_data):
+    stats = calculate_genome_stats(
+        str(ref_data / "abisko4" / "73.20110600_S2D.10.fna"))
+    assert stats.num_contigs == 161
+    assert stats.num_ambiguous_bases == 6506
+    assert stats.n50 == 8289
+
+
+def test_single_contig_n50(tmp_path):
+    p = tmp_path / "one.fna"
+    p.write_text(">c1\n" + "ACGT" * 25 + "\n")
+    stats = calculate_genome_stats(str(p))
+    assert stats.num_contigs == 1
+    assert stats.num_ambiguous_bases == 0
+    assert stats.n50 == 100
+
+
+def test_codes_and_offsets(tmp_path):
+    p = tmp_path / "two.fna"
+    p.write_text(">a\nACGTN\nacgt\n>b desc\nTTTT\n")
+    g = read_genome(str(p))
+    assert g.stats.num_contigs == 2
+    assert g.stats.num_ambiguous_bases == 1
+    np.testing.assert_array_equal(g.contig_offsets, [0, 9, 13])
+    np.testing.assert_array_equal(
+        g.codes, [0, 1, 2, 3, 255, 0, 1, 2, 3, 3, 3, 3, 3])
+
+
+def test_gzip_roundtrip(tmp_path):
+    import gzip
+
+    p = tmp_path / "g.fna.gz"
+    with gzip.open(p, "wt") as fh:
+        fh.write(">a\nACGTACGT\n")
+    g = read_genome(str(p))
+    assert g.length == 8
+    assert g.stats.n50 == 8
