@@ -1,0 +1,11 @@
+from galah_tpu.backends.base import (  # noqa: F401
+    ClusterBackend,
+    PreclusterBackend,
+)
+from galah_tpu.backends.minhash_backend import MinHashPreclusterer  # noqa: F401
+from galah_tpu.backends.fragment_backend import (  # noqa: F401
+    FastANIEquivalentClusterer,
+    ProfileStore,
+    SkaniEquivalentClusterer,
+    SkaniPreclusterer,
+)

@@ -1,0 +1,63 @@
+"""Backend abstractions for the two-stage distance pipeline.
+
+TPU-native re-design of the reference's two traits (reference:
+src/lib.rs:23-37):
+
+  * PreclusterDistanceFinder.distances(&[&str]) -> sparse pair cache
+  * ClusterDistanceFinder.calculate_ani(f1, f2) -> Option<f32>
+
+The key difference: the cluster-stage interface is **batched**. The
+reference computes one genome pair per thread/subprocess call; here the
+engine hands a whole candidate list to the backend at once so it can be
+evaluated as a single device computation (and sketches are computed once
+per genome and cached — fixing the reference's per-pair re-sketching,
+reference: src/skani.rs:171-172).
+
+ANI values everywhere are fractions in [0, 1] (the reference mixes
+percent and fraction units across backends; this framework normalizes).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING, List, Optional, Sequence
+
+if TYPE_CHECKING:
+    from galah_tpu.cluster.cache import PairDistanceCache
+
+
+class PreclusterBackend(abc.ABC):
+    """Cheap sketch-based all-pairs pass producing the sparse pair cache."""
+
+    @abc.abstractmethod
+    def method_name(self) -> str: ...
+
+    @abc.abstractmethod
+    def distances(self, genome_paths: Sequence[str]) -> "PairDistanceCache":
+        """ANI fraction for every i<j pair passing the precluster
+        threshold."""
+
+
+class ClusterBackend(abc.ABC):
+    """Exact-ANI backend driving the greedy clustering decisions."""
+
+    @abc.abstractmethod
+    def method_name(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def ani_threshold(self) -> float:
+        """Final clustering ANI threshold, as a fraction."""
+
+    @abc.abstractmethod
+    def calculate_ani_batch(
+        self, pairs: Sequence[tuple[str, str]]
+    ) -> List[Optional[float]]:
+        """ANI for each (path_a, path_b); None = failed aligned-fraction
+        gate. The batch interface lets backends keep all inputs
+        device-resident and (where shapes allow) coalesce dispatches;
+        current fragment backends dispatch per direction with cached
+        device arrays."""
+
+    def calculate_ani(self, f1: str, f2: str) -> Optional[float]:
+        return self.calculate_ani_batch([(f1, f2)])[0]

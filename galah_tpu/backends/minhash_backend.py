@@ -1,0 +1,76 @@
+"""MinHash precluster backend (finch-equivalent) on the device pipeline.
+
+Semantics of the reference's FinchPreclusterer (reference:
+src/finch.rs:4-73): sketch every genome (bottom-k 1000, k=21, seed 0),
+all-pairs Mash ANI, keep pairs at or above the threshold. The all-pairs
+loop runs as the tiled device kernel of ops/pairwise.py instead of a host
+O(N^2) loop.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Sequence
+
+from galah_tpu.backends.base import PreclusterBackend
+from galah_tpu.cluster.cache import PairDistanceCache
+from galah_tpu.config import Defaults
+from galah_tpu.io.fasta import read_genome
+from galah_tpu.ops.minhash import sketch_genome_device, sketch_matrix
+from galah_tpu.ops.minhash_np import MinHashSketch
+from galah_tpu.ops.pairwise import threshold_pairs
+
+logger = logging.getLogger(__name__)
+
+
+class SketchStore:
+    """Per-run cache: genome path -> MinHash sketch (sketch once, reuse)."""
+
+    def __init__(self, sketch_size: int, k: int, seed: int = 0) -> None:
+        self.sketch_size = sketch_size
+        self.k = k
+        self.seed = seed
+        self._sketches: Dict[str, MinHashSketch] = {}
+
+    def get(self, path: str) -> MinHashSketch:
+        s = self._sketches.get(path)
+        if s is None:
+            s = sketch_genome_device(
+                read_genome(path), sketch_size=self.sketch_size,
+                k=self.k, seed=self.seed)
+            self._sketches[path] = s
+        return s
+
+
+class MinHashPreclusterer(PreclusterBackend):
+    def __init__(
+        self,
+        min_ani: float,
+        sketch_size: int = Defaults.MINHASH_SKETCH_SIZE,
+        k: int = Defaults.MINHASH_KMER,
+        store: Optional[SketchStore] = None,
+    ) -> None:
+        self.min_ani = float(min_ani)
+        self.sketch_size = sketch_size
+        self.k = k
+        self.store = store or SketchStore(sketch_size, k)
+
+    def method_name(self) -> str:
+        return "finch"
+
+    def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
+        logger.info(
+            "Sketching MinHash representations of %d genomes on device ..",
+            len(genome_paths))
+        sketches = [self.store.get(p) for p in genome_paths]
+        mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
+        logger.info("Computing tiled all-pairs Mash ANI ..")
+        pairs = threshold_pairs(
+            mat, k=self.k, min_ani=self.min_ani,
+            sketch_size=self.sketch_size)
+        cache = PairDistanceCache()
+        for (i, j), ani in pairs.items():
+            cache.insert((i, j), ani)
+        logger.info("Found %d pairs passing precluster threshold %.4f",
+                    len(cache), self.min_ani)
+        return cache

@@ -1,0 +1,2 @@
+from galah_tpu.cluster.cache import PairDistanceCache  # noqa: F401
+from galah_tpu.cluster.engine import cluster  # noqa: F401

@@ -1,0 +1,193 @@
+"""Two-stage greedy clustering engine.
+
+Re-implements the reference's engine semantics exactly (reference:
+src/clusterer.rs:14-125) with one structural change: every per-genome
+candidate ANI set is evaluated as ONE batched backend call instead of the
+reference's per-pair threads with `find_any` early exit. The greedy
+decisions are identical — "is any candidate ANI >= threshold" does not
+depend on which subset the early exit happened to compute — but here they
+are deterministic, and the ANI cache is a superset of the reference's.
+
+Semantics preserved:
+  * genomes arrive pre-sorted by quality; rep selection scans them in
+    order, so earlier (higher-quality) genomes become representatives
+    (reference: src/clusterer.rs:164-223).
+  * candidate reps for genome i = current reps with a precluster-cache
+    hit against i (reference: src/clusterer.rs:167-177).
+  * when precluster and cluster methods match, precluster ANIs are reused
+    instead of recomputed (reference: src/clusterer.rs:29-33,180-186).
+  * membership: each non-rep is assigned to the argmax-ANI rep over all
+    cached/computed rep ANIs — NO threshold filter at this stage, ties
+    to the lowest rep index (reference: src/clusterer.rs:371-403).
+  * rep-phase ANIs carry into the membership phase via the shared cache
+    (reference: src/clusterer.rs:160-162,211,321-334).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence, Set, Tuple
+
+from galah_tpu.backends.base import ClusterBackend, PreclusterBackend
+from galah_tpu.cluster.cache import PairDistanceCache, pair_key
+from galah_tpu.cluster.partition import partition_preclusters
+
+logger = logging.getLogger(__name__)
+
+
+def cluster(
+    genomes: Sequence[str],
+    preclusterer: PreclusterBackend,
+    clusterer: ClusterBackend,
+) -> List[List[int]]:
+    """Cluster quality-ordered genome paths -> list of index clusters.
+
+    Each cluster lists its representative first; clusters are ordered by
+    representative index ascending (deterministic, unlike the reference's
+    thread-completion order).
+    """
+    skip_clusterer = preclusterer.method_name() == clusterer.method_name()
+    if skip_clusterer:
+        logger.info(
+            "Preclustering and clustering methods are the same, "
+            "so reusing ANI values")
+
+    pre_cache = preclusterer.distances(genomes)
+
+    logger.info("Preclustering ..")
+    preclusters = partition_preclusters(len(genomes), pre_cache.keys())
+    logger.info("Found %d preclusters. The largest contained %d genomes",
+                len(preclusters), len(preclusters[0]) if preclusters else 0)
+
+    logger.info(
+        "Finding representative genomes and assigning all genomes ..")
+    all_clusters: List[List[int]] = []
+    for members in preclusters:
+        local_cache = pre_cache.transform_ids(members)
+        local_genomes = [genomes[g] for g in members]
+        reps, ani_cache = _find_representatives(
+            clusterer, local_cache, local_genomes, skip_clusterer)
+        local_clusters = _find_memberships(
+            clusterer, reps, local_cache, local_genomes, ani_cache,
+            skip_clusterer)
+        for c in local_clusters:
+            all_clusters.append([members[i] for i in c])
+    all_clusters.sort(key=lambda c: c[0])
+    logger.info("Found %d clusters", len(all_clusters))
+    return all_clusters
+
+
+def _batch_ani(
+    clusterer: ClusterBackend,
+    skip_clusterer: bool,
+    pre_cache: PairDistanceCache,
+    genomes: Sequence[str],
+    pairs: Sequence[Tuple[int, int]],
+) -> List[Optional[float]]:
+    """ANI for local index pairs: precluster reuse or batched backend call.
+
+    With matching methods, a precluster-cache hit is authoritative (same
+    algorithm, same parameters — reference: src/clusterer.rs:264-279);
+    only missing pairs go to the backend.
+    """
+    out: List[Optional[float]] = [None] * len(pairs)
+    to_compute: List[Tuple[int, Tuple[str, str]]] = []
+    for n, (i, j) in enumerate(pairs):
+        if skip_clusterer and pre_cache.contains((i, j)):
+            out[n] = pre_cache.get((i, j))
+        else:
+            to_compute.append((n, (genomes[i], genomes[j])))
+    if to_compute:
+        anis = clusterer.calculate_ani_batch([p for _, p in to_compute])
+        for (n, _), ani in zip(to_compute, anis):
+            out[n] = ani
+    return out
+
+
+def _find_representatives(
+    clusterer: ClusterBackend,
+    pre_cache: PairDistanceCache,
+    genomes: Sequence[str],
+    skip_clusterer: bool,
+) -> Tuple[Set[int], PairDistanceCache]:
+    """Greedy quality-ordered representative selection.
+
+    Reference: src/clusterer.rs:155-225 (find_dashing_fastani_
+    representatives). Genome i becomes a representative iff no existing
+    rep with a precluster hit has exact ANI >= threshold.
+    """
+    reps: Set[int] = set()
+    ani_cache = PairDistanceCache()
+    thr = clusterer.ani_threshold
+    for i in range(len(genomes)):
+        cands = [(j, pre_cache.get((i, j))) for j in sorted(reps)
+                 if pre_cache.contains((i, j))]
+        # ascending by precluster ANI — preserved from the reference
+        # (its comment says "highest first" but the sort is ascending,
+        # reference: src/clusterer.rs:167-177)
+        cands.sort(key=lambda t: t[1] if t[1] is not None else -1.0)
+        anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes,
+                          [(j, i) for j, _ in cands])
+        is_rep = True
+        for (j, _), ani in zip(cands, anis):
+            if ani is not None:
+                # reps always have lower quality rank than i here, but the
+                # cache key is sorted either way
+                ani_cache.insert((j, i), ani)
+                if ani >= thr:
+                    is_rep = False
+        if is_rep:
+            logger.debug("Genome designated representative: %d %s",
+                         i, genomes[i])
+            reps.add(i)
+    return reps, ani_cache
+
+
+def _find_memberships(
+    clusterer: ClusterBackend,
+    reps: Set[int],
+    pre_cache: PairDistanceCache,
+    genomes: Sequence[str],
+    ani_cache: PairDistanceCache,
+    skip_clusterer: bool,
+) -> List[List[int]]:
+    """Assign every non-rep to its best (argmax exact ANI) representative.
+
+    Reference: src/clusterer.rs:316-406 (find_dashing_fastani_
+    memberships). Candidates needing computation are precluster hits not
+    already in the ANI cache; the batched call covers ALL non-reps at
+    once (one device dispatch), then argmax with ties to the lowest rep
+    index.
+    """
+    rep_list = sorted(reps)
+    rep_to_cluster = {r: n for n, r in enumerate(rep_list)}
+    clusters: List[List[int]] = [[r] for r in rep_list]
+
+    # Collect every (genome, rep) pair that still needs exact ANI.
+    todo: List[Tuple[int, int]] = []
+    for i in range(len(genomes)):
+        if i in reps:
+            continue
+        for r in rep_list:
+            if not ani_cache.contains((i, r)) and pre_cache.contains((i, r)):
+                todo.append((r, i))
+    anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes, todo)
+    for (r, i), ani in zip(todo, anis):
+        ani_cache.insert((r, i), ani)  # None recorded too, as the ref does
+
+    for i in range(len(genomes)):
+        if i in reps:
+            continue
+        best_rep = None
+        best_ani = None
+        for r in rep_list:
+            ani = ani_cache.get((i, r))
+            if ani is not None and (best_ani is None or ani > best_ani):
+                best_rep = r
+                best_ani = ani
+        if best_rep is None:
+            raise RuntimeError(
+                f"genome {genomes[i]} passed the representative test but "
+                "has no ANI to any representative — inconsistent backend")
+        clusters[rep_to_cluster[best_rep]].append(i)
+    return clusters

@@ -138,6 +138,42 @@ def canonical_kmer_hashes_chunk(
     return jnp.where(valid, hashes, HASH_SENTINEL)
 
 
+def iter_chunk_hashes(codes, contig_offsets, k: int, chunk: int, seed: int = 0):
+    """Yield (hashes, n_new) device arrays over fixed-size overlapping chunks.
+
+    Single implementation of the chunk/pad/overlap discipline shared by the
+    MinHash sketcher and the fragment-ANI profiler: chunks overlap by k-1 so
+    every k-mer window is hashed exactly once; `n_new` is how many leading
+    entries of `hashes` are first-time positions (the rest are overlap).
+    """
+    import numpy as np
+
+    if chunk <= k - 1:
+        raise ValueError(f"chunk ({chunk}) must exceed k-1 ({k - 1})")
+    n = codes.shape[0]
+    boundary = np.zeros(n, dtype=np.int32)
+    if contig_offsets.shape[0] > 2:
+        boundary = np.searchsorted(
+            contig_offsets, np.arange(n), side="right").astype(np.int32)
+
+    step = chunk - (k - 1)
+    pos = 0
+    total = max(n - k + 1, 0)
+    while pos < total or pos == 0:
+        end = min(pos + chunk, n)
+        c = np.full(chunk, 255, dtype=np.uint8)
+        b = np.full(chunk, -1, dtype=np.int32)
+        c[: end - pos] = codes[pos:end]
+        b[: end - pos] = boundary[pos:end]
+        hashes = canonical_kmer_hashes_chunk(
+            jnp.asarray(c), jnp.asarray(b), k=k, seed=seed)
+        n_new = min(total - pos, chunk - k + 1) if total else 0
+        yield hashes, pos, n_new
+        pos += step
+        if end >= n:
+            break
+
+
 @functools.partial(jax.jit, static_argnames=("sketch_size",))
 def bottom_k_update(
     running: jax.Array,  # uint64 (sketch_size,) sorted asc, SENTINEL-padded

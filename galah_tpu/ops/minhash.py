@@ -33,33 +33,11 @@ def sketch_genome_device(
     chunk: int = DEFAULT_CHUNK,
 ) -> MinHashSketch:
     """Bottom-k distinct canonical k-mer sketch, computed on device."""
-    if chunk <= k - 1:
-        raise ValueError(f"chunk ({chunk}) must exceed k-1 ({k - 1})")
-    codes = genome.codes
-    n = codes.shape[0]
-    # Contig id per position, so windows spanning contigs are masked out.
-    boundary = np.zeros(n, dtype=np.int32)
-    offs = genome.contig_offsets
-    if offs.shape[0] > 2:
-        boundary = (
-            np.searchsorted(offs, np.arange(n), side="right").astype(np.int32))
-
     running = jnp.full((sketch_size,), hashing.HASH_SENTINEL)
-    step = chunk - (k - 1)
-    pos = 0
-    while pos < max(n - k + 1, 1) or pos == 0:
-        end = min(pos + chunk, n)
-        c = np.full(chunk, 255, dtype=np.uint8)
-        b = np.full(chunk, -1, dtype=np.int32)
-        c[: end - pos] = codes[pos:end]
-        b[: end - pos] = boundary[pos:end]
-        hashes = hashing.canonical_kmer_hashes_chunk(
-            jnp.asarray(c), jnp.asarray(b), k=k, seed=seed)
+    for hashes, _pos, _n_new in hashing.iter_chunk_hashes(
+            genome.codes, genome.contig_offsets, k=k, chunk=chunk, seed=seed):
         running = hashing.bottom_k_update(
             running, hashes, sketch_size=sketch_size)
-        pos += step
-        if end >= n:
-            break
 
     out = np.asarray(running)
     out = out[out != np.uint64(SENTINEL)]

@@ -95,6 +95,22 @@ def tile_stats(rows: jax.Array, cols: jax.Array,
     return jax.vmap(one_row)(rows)
 
 
+@jax.jit
+def tile_intersect_counts(rows: jax.Array, cols: jax.Array) -> jax.Array:
+    """|row ∩ col| for sorted SENTINEL-padded hash rows -> (Br, Bc) int32.
+
+    Used for marker-containment screening (the skani-equivalent
+    preclusterer's candidate filter, reference: src/skani.rs:54-70).
+    """
+    def one_pair(a, b):
+        valid = a != HASH_SENTINEL
+        pos = jnp.searchsorted(b, a)
+        hit = jnp.take(b, jnp.minimum(pos, b.shape[0] - 1)) == a
+        return jnp.sum((hit & valid).astype(jnp.int32))
+
+    return jax.vmap(lambda a: jax.vmap(lambda b: one_pair(a, b))(cols))(rows)
+
+
 def _block_ani(block_rows: jax.Array, all_cols: jax.Array,
                sketch_size: int, k: int, col_tile: int) -> jax.Array:
     """(Br, N) ANI of a row block vs all columns, lax.map over col tiles."""

