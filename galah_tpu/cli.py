@@ -1,39 +1,299 @@
 """Command-line interface: `galah-tpu cluster` / `galah-tpu cluster-validate`.
 
-Mirrors the reference CLI surface (reference: src/main.rs:53-118,
-src/cluster_argument_parsing.rs:1265-1375). Subcommands land incrementally;
-unimplemented ones exit with a clear message rather than a traceback.
+Flag surface mirrors the reference CLI (reference: src/main.rs:53-118 and
+src/cluster_argument_parsing.rs:1265-1375); percentage arguments accept
+either 1-100 or 0-1 and normalize to fractions (reference:
+src/cluster_argument_parsing.rs:1160-1182). The compute path underneath is
+the TPU-native pipeline.
 """
 
 from __future__ import annotations
 
 import argparse
+import logging
 import sys
 
 import galah_tpu
+from galah_tpu.config import (
+    CLUSTER_METHODS,
+    Defaults,
+    PRECLUSTER_METHODS,
+    QUALITY_FORMULAS,
+    parse_percentage,
+)
+from galah_tpu.utils.logging import set_log_level
+
+logger = logging.getLogger("galah_tpu")
+
+
+def _add_verbosity(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="Print extra debugging information")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="Unless there is an error, do not print log messages")
+
+
+def _add_genome_inputs(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-f", "--genome-fasta-files", nargs="+",
+                   help="Path(s) to FASTA files of each genome")
+    p.add_argument("--genome-fasta-list",
+                   help="File containing FASTA file paths, one per line")
+    p.add_argument("-d", "--genome-fasta-directory",
+                   help="Directory containing FASTA files of each genome")
+    p.add_argument("-x", "--genome-fasta-extension", default="fna",
+                   help="File extension of genomes in the directory "
+                        "(default: fna)")
 
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="galah-tpu",
-        description="TPU-native genome dereplication (ANI clustering with "
-                    "quality-ranked representatives)")
+        description="Metagenome assembled genome (MAG) dereplicator / "
+                    "clusterer, TPU-native")
     parser.add_argument("--version", action="version",
                         version=galah_tpu.__version__)
     sub = parser.add_subparsers(dest="subcommand")
-    sub.add_parser("cluster", add_help=False)
-    sub.add_parser("cluster-validate", add_help=False)
+
+    c = sub.add_parser(
+        "cluster",
+        help="Cluster genomes by ANI, choosing quality-ranked "
+             "representatives")
+    _add_verbosity(c)
+    _add_genome_inputs(c)
+    c.add_argument("--ani", type=float, default=Defaults.ANI,
+                   help="Average nucleotide identity threshold for "
+                        "clustering (default: 95)")
+    c.add_argument("--precluster-ani", type=float,
+                   default=Defaults.PRETHRESHOLD_ANI,
+                   help="Require at least this sketch-derived ANI for "
+                        "preclustering (default: 90)")
+    c.add_argument("--min-aligned-fraction", type=float,
+                   default=Defaults.ALIGNED_FRACTION * 100,
+                   help="Min aligned fraction of two genomes for "
+                        "clustering (default: 15)")
+    c.add_argument("--fragment-length", type=int,
+                   default=Defaults.FRAGMENT_LENGTH,
+                   help="Length of fragment used in fastANI-style "
+                        "calculation (default: 3000)")
+    c.add_argument("--precluster-method", default=Defaults.PRECLUSTER_METHOD,
+                   choices=PRECLUSTER_METHODS,
+                   help="Method of calculating rough ANI for "
+                        "dereplication (default: skani)")
+    c.add_argument("--cluster-method", default=Defaults.CLUSTER_METHOD,
+                   choices=CLUSTER_METHODS,
+                   help="Method of calculating exact ANI for "
+                        "dereplication (default: skani)")
+    c.add_argument("--checkm-tab-table",
+                   help="Output of `checkm qa .. --tab_table`")
+    c.add_argument("--checkm2-quality-report",
+                   help="CheckM2 quality_report.tsv output")
+    c.add_argument("--genome-info",
+                   help="dRep-style genome info CSV "
+                        "(genome,completeness,contamination)")
+    c.add_argument("--min-completeness", type=float,
+                   help="Ignore genomes with less completeness than this "
+                        "percentage")
+    c.add_argument("--max-contamination", type=float,
+                   help="Ignore genomes with more contamination than this "
+                        "percentage")
+    c.add_argument("--quality-formula", default=Defaults.QUALITY_FORMULA,
+                   choices=QUALITY_FORMULAS,
+                   help="Quality formula for ranking genomes "
+                        "(default: Parks2020_reduced)")
+    c.add_argument("--threads", "-t", type=int, default=1,
+                   help="Host threads for FASTA stats/IO fan-out; device "
+                        "parallelism is managed by the mesh")
+    c.add_argument("--output-cluster-definition",
+                   help="Output file of rep<TAB>member lines")
+    c.add_argument("--output-representative-fasta-directory",
+                   help="Symlink representative genomes into this directory")
+    c.add_argument("--output-representative-fasta-directory-copy",
+                   help="Copy representative genomes into this directory")
+    c.add_argument("--output-representative-list",
+                   help="Output file with one representative path per line")
+
+    v = sub.add_parser("cluster-validate", help="Verify clustering results")
+    _add_verbosity(v)
+    v.add_argument("--cluster-file", required=True,
+                   help="Output of 'cluster' subcommand")
+    v.add_argument("--ani", type=float, default=99.0,
+                   help="ANI to validate against (default: 99)")
+    v.add_argument("--min-aligned-fraction", type=float, default=50.0,
+                   help="Min aligned fraction of two genomes "
+                        "(default: 50)")
+    v.add_argument("--fragment-length", type=int,
+                   default=Defaults.FRAGMENT_LENGTH,
+                   help="Length of fragment used in fastANI-style "
+                        "calculation (default: 3000)")
+    v.add_argument("--threads", "-t", type=int, default=1)
     return parser
 
 
-def main(argv=None) -> int:
-    args, _rest = build_parser().parse_known_args(argv)
-    if args.subcommand is None:
-        build_parser().print_help()
+def _build_backends(args, store=None):
+    """Backend factory (reference: generate_galah_clusterer,
+    src/cluster_argument_parsing.rs:897-1158)."""
+    from galah_tpu.backends import (
+        FastANIEquivalentClusterer,
+        MinHashPreclusterer,
+        ProfileStore,
+        SkaniEquivalentClusterer,
+        SkaniPreclusterer,
+    )
+
+    ani = parse_percentage(args.ani, "--ani")
+    precluster_ani = parse_percentage(args.precluster_ani, "--precluster-ani")
+    min_af = parse_percentage(args.min_aligned_fraction,
+                              "--min-aligned-fraction")
+
+    # skani+skani special case: precluster at the final ANI threshold
+    # (unconditionally) so reused values reflect the real cutoff
+    # (reference: src/cluster_argument_parsing.rs:983-1030, exercised by
+    # the reference's test_skani_skani_clusterer with --precluster-ani 99
+    # --ani 95 clustering everything at 95).
+    if args.precluster_method == "skani" and args.cluster_method == "skani":
+        if precluster_ani != ani:
+            logger.info(
+                "Preclustering at the final ANI threshold %.4f since "
+                "precluster and cluster methods are both skani", ani)
+        precluster_ani = ani
+
+    store = store or ProfileStore(fraglen=args.fragment_length)
+    if args.precluster_method == "finch":
+        pre = MinHashPreclusterer(min_ani=precluster_ani)
+    elif args.precluster_method == "skani":
+        pre = SkaniPreclusterer(
+            threshold=precluster_ani, min_aligned_fraction=min_af,
+            store=store)
+    elif args.precluster_method == "dashing":
+        # HyperLogLog subprocess backend in the reference; the device
+        # MinHash kernel covers its role here.
+        logger.warning(
+            "dashing precluster method maps to the device MinHash "
+            "(finch-equivalent) backend in this framework")
+        pre = MinHashPreclusterer(min_ani=precluster_ani)
+    else:
+        raise ValueError(args.precluster_method)
+
+    if args.cluster_method == "fastani":
+        cl = FastANIEquivalentClusterer(
+            threshold=ani, min_aligned_fraction=min_af,
+            fraglen=args.fragment_length, store=store)
+    elif args.cluster_method == "skani":
+        cl = SkaniEquivalentClusterer(
+            threshold=ani, min_aligned_fraction=min_af, store=store)
+    else:
+        raise ValueError(args.cluster_method)
+    return pre, cl
+
+
+def run_cluster(args) -> int:
+    from galah_tpu import quality as quality_mod
+    from galah_tpu.cluster import cluster as run_clustering
+    from galah_tpu.genome_inputs import parse_genome_inputs
+    from galah_tpu.outputs import setup_outputs, write_outputs
+
+    genomes = parse_genome_inputs(
+        genome_fasta_files=args.genome_fasta_files,
+        genome_fasta_list=args.genome_fasta_list,
+        genome_fasta_directory=args.genome_fasta_directory,
+        genome_fasta_extension=args.genome_fasta_extension,
+    )
+
+    # Quality filter + ordering (reference: filter_genomes_through_checkm,
+    # src/cluster_argument_parsing.rs:576-832)
+    n_quality_inputs = sum(
+        1 for x in (args.checkm_tab_table, args.checkm2_quality_report,
+                    args.genome_info) if x)
+    if n_quality_inputs > 1:
+        logger.error("Specify at most one of --checkm-tab-table, "
+                     "--checkm2-quality-report and --genome-info")
         return 1
-    print(f"galah-tpu {args.subcommand}: not implemented yet in this build",
-          file=sys.stderr)
-    return 1
+    if n_quality_inputs == 0:
+        logger.warning(
+            "Since CheckM input is missing, genomes are not being ordered "
+            "by quality. Instead the order of their input is being used")
+    else:
+        if args.checkm_tab_table:
+            logger.info("Reading CheckM tab table ..")
+            table = quality_mod.read_checkm1_tab_table(args.checkm_tab_table)
+        elif args.checkm2_quality_report:
+            logger.info("Reading CheckM2 Quality report ..")
+            table = quality_mod.read_checkm2_quality_report(
+                args.checkm2_quality_report)
+        else:
+            if args.quality_formula == "dRep":
+                logger.error(
+                    "The dRep quality formula cannot be used with "
+                    "--genome-info")
+                return 1
+            logger.info("Reading genome info file %s", args.genome_info)
+            table = quality_mod.read_genome_info_file(args.genome_info)
+        genomes = quality_mod.filter_and_order_genomes(
+            genomes, table,
+            formula=args.quality_formula,
+            min_completeness=(parse_percentage(
+                args.min_completeness, "--min-completeness")
+                if args.min_completeness is not None else None),
+            max_contamination=(parse_percentage(
+                args.max_contamination, "--max-contamination")
+                if args.max_contamination is not None else None),
+            threads=args.threads,
+        )
+
+    pre, cl = _build_backends(args)
+
+    # Open output handles before compute (fail fast)
+    handles = setup_outputs(
+        cluster_definition=args.output_cluster_definition,
+        representative_fasta_directory=(
+            args.output_representative_fasta_directory),
+        representative_fasta_directory_copy=(
+            args.output_representative_fasta_directory_copy),
+        representative_list=args.output_representative_list,
+    )
+
+    logger.info("Clustering %d genomes ..", len(genomes))
+    clusters = run_clustering(genomes, pre, cl)
+    logger.info("Found %d genome clusters", len(clusters))
+
+    write_outputs(handles, clusters, genomes)
+    logger.info("Finished printing genome clusters")
+    return 0
+
+
+def run_cluster_validate(args) -> int:
+    from galah_tpu.backends import FastANIEquivalentClusterer, ProfileStore
+    from galah_tpu.validate import validate_clusters
+
+    ani = parse_percentage(args.ani, "--ani")
+    min_af = parse_percentage(args.min_aligned_fraction,
+                              "--min-aligned-fraction")
+    clusterer = FastANIEquivalentClusterer(
+        threshold=ani, min_aligned_fraction=min_af,
+        fraglen=args.fragment_length,
+        store=ProfileStore(fraglen=args.fragment_length))
+    validate_clusters(args.cluster_file, clusterer)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.subcommand is None:
+        parser.print_help()
+        return 1
+    set_log_level(verbose=getattr(args, "verbose", False),
+                  quiet=getattr(args, "quiet", False))
+    logger.info("galah-tpu version %s", galah_tpu.__version__)
+    try:
+        if args.subcommand == "cluster":
+            return run_cluster(args)
+        else:
+            return run_cluster_validate(args)
+    except (ValueError, FileNotFoundError, KeyError) as e:
+        # expected user errors: clean message, nonzero exit, no traceback
+        logger.error("%s", e.args[0] if e.args else e)
+        return 1
 
 
 if __name__ == "__main__":

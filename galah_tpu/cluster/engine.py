@@ -43,8 +43,9 @@ def cluster(
     """Cluster quality-ordered genome paths -> list of index clusters.
 
     Each cluster lists its representative first; clusters are ordered by
-    representative index ascending (deterministic, unlike the reference's
-    thread-completion order).
+    precluster processing order (biggest precluster first) then by
+    representative index — deterministic, unlike the reference's
+    thread-completion order.
     """
     skip_clusterer = preclusterer.method_name() == clusterer.method_name()
     if skip_clusterer:
@@ -72,7 +73,6 @@ def cluster(
             skip_clusterer)
         for c in local_clusters:
             all_clusters.append([members[i] for i in c])
-    all_clusters.sort(key=lambda c: c[0])
     logger.info("Found %d clusters", len(all_clusters))
     return all_clusters
 

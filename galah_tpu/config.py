@@ -50,17 +50,18 @@ QUALITY_FORMULAS = (
 
 
 def parse_percentage(value: float, name: str = "value") -> float:
-    """Normalize a percentage argument to a fraction in (0, 1].
+    """Normalize a percentage argument to a fraction in [0, 1].
 
-    Accepts either 1-100 (percent) or 0-1 (fraction), like the reference's
-    parse_percentage (reference: src/cluster_argument_parsing.rs:1160-1182).
+    Reference semantics (src/cluster_argument_parsing.rs:1160-1182):
+    values in [1, 100] are percent (so exactly 1 means 1%, not 100%);
+    values in [0, 1) are already fractions; anything else is an error.
     """
     v = float(value)
-    if v > 1.0:
-        v = v / 100.0
-    if not (0.0 < v <= 1.0):
-        raise ValueError(f"{name} must be within (0, 100], got {value}")
-    return v
+    if 1.0 <= v <= 100.0:
+        return v / 100.0
+    if 0.0 <= v < 1.0:
+        return v
+    raise ValueError(f"{name} must be within [0, 100], got {value}")
 
 
 @dataclasses.dataclass

@@ -1,0 +1,45 @@
+"""Genome input specification: -f / -d / -x / --genome-fasta-list.
+
+Mirrors the bird_tool_utils genome-input contract the reference uses
+(reference: docs/galah-cluster.html GENOME INPUT section, consumed via
+parse_list_of_genome_fasta_files at src/cluster_argument_parsing.rs:414):
+explicit file lists, a list-file of paths, or a directory scanned for a
+given extension (default "fna"). At least one source must be provided.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+
+def parse_genome_inputs(
+    genome_fasta_files: Optional[Sequence[str]] = None,
+    genome_fasta_list: Optional[str] = None,
+    genome_fasta_directory: Optional[str] = None,
+    genome_fasta_extension: str = "fna",
+) -> List[str]:
+    out: List[str] = []
+    if genome_fasta_files:
+        out.extend(genome_fasta_files)
+    if genome_fasta_list:
+        with open(genome_fasta_list) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    out.append(line)
+    if genome_fasta_directory:
+        suffix = "." + genome_fasta_extension.lstrip(".")
+        entries = sorted(os.listdir(genome_fasta_directory))
+        out.extend(
+            os.path.join(genome_fasta_directory, e)
+            for e in entries if e.endswith(suffix))
+    if not out:
+        raise ValueError(
+            "No genome input specified: use --genome-fasta-files, "
+            "--genome-fasta-list or --genome-fasta-directory")
+    missing = [p for p in out if not os.path.isfile(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"Genome FASTA file(s) not found: {missing[:5]}")
+    return out

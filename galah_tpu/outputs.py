@@ -1,0 +1,151 @@
+"""Output writing: cluster TSV, representative symlink/copy dirs, rep list.
+
+Mirrors the reference's output layer (reference:
+src/cluster_argument_parsing.rs:367-562): output files are opened and
+directories created BEFORE clustering so failures surface early; the
+cluster definition file holds "rep\tmember" lines (rep = first member of
+each cluster); representative FASTAs are symlinked or copied into output
+directories with `.1.fna`-style renaming on basename clashes; the rep
+list file holds one representative path per line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import shutil
+import sys
+from typing import List, Optional, Sequence, TextIO
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class OutputHandles:
+    cluster_definition: Optional[TextIO] = None
+    representative_fasta_directory: Optional[str] = None
+    representative_fasta_directory_copy: Optional[str] = None
+    representative_list: Optional[TextIO] = None
+
+
+def _setup_directory(path: Optional[str], argument: str) -> Optional[str]:
+    """Create (or accept empty pre-existing) output directory, fail fast
+    otherwise (reference: src/cluster_argument_parsing.rs:488-522)."""
+    if path is None:
+        return None
+    if os.path.exists(path):
+        if not os.path.isdir(path):
+            logger.error("The %s path specified (%s) exists but is not a "
+                         "directory", argument, path)
+            sys.exit(1)
+        if os.listdir(path):
+            logger.error("The %s specified (%s) exists and is not empty",
+                         argument, path)
+            sys.exit(1)
+        logger.info("Using pre-existing but empty %s", argument)
+    else:
+        logger.info("Creating %s ..", argument)
+        os.makedirs(path, exist_ok=True)
+    return path
+
+
+def setup_outputs(
+    cluster_definition: Optional[str] = None,
+    representative_fasta_directory: Optional[str] = None,
+    representative_fasta_directory_copy: Optional[str] = None,
+    representative_list: Optional[str] = None,
+) -> OutputHandles:
+    """Open files / create directories before compute (fail-fast)."""
+    return OutputHandles(
+        cluster_definition=(open(cluster_definition, "w")
+                            if cluster_definition else None),
+        representative_fasta_directory=_setup_directory(
+            representative_fasta_directory,
+            "output-representative-fasta-directory"),
+        representative_fasta_directory_copy=_setup_directory(
+            representative_fasta_directory_copy,
+            "output-representative-fasta-directory-copy"),
+        representative_list=(open(representative_list, "w")
+                             if representative_list else None),
+    )
+
+
+def _write_reps_to_directory(
+    clusters: Sequence[Sequence[int]],
+    genomes: Sequence[str],
+    directory: Optional[str],
+    copy: bool,
+) -> None:
+    if directory is None:
+        return
+    some_names_clashed = False
+    for cluster in clusters:
+        rep = genomes[cluster[0]]
+        src = os.path.realpath(rep)
+        basename = os.path.basename(rep)
+        target = os.path.join(directory, basename)
+        counter = 0
+        while os.path.lexists(target):
+            if not some_names_clashed:
+                logger.warning(
+                    "One or more sequence files have the same file name. "
+                    "Renaming clashes by adding .1.fna, .2.fna etc.")
+                some_names_clashed = True
+            counter += 1
+            target = os.path.join(directory, f"{basename}.{counter}.fna")
+        if copy:
+            shutil.copy(src, target)
+        else:
+            os.symlink(src, target)
+
+
+def write_outputs(
+    handles: OutputHandles,
+    clusters: Sequence[Sequence[int]],
+    genomes: Sequence[str],
+) -> None:
+    """Write all requested outputs (reference:
+    src/cluster_argument_parsing.rs:432-485)."""
+    if handles.cluster_definition is not None:
+        for cluster in clusters:
+            rep = genomes[cluster[0]]
+            for genome_index in cluster:
+                handles.cluster_definition.write(
+                    f"{rep}\t{genomes[genome_index]}\n")
+        handles.cluster_definition.close()
+
+    _write_reps_to_directory(
+        clusters, genomes, handles.representative_fasta_directory, copy=False)
+    _write_reps_to_directory(
+        clusters, genomes, handles.representative_fasta_directory_copy,
+        copy=True)
+
+    if handles.representative_list is not None:
+        for cluster in clusters:
+            handles.representative_list.write(f"{genomes[cluster[0]]}\n")
+        handles.representative_list.close()
+
+
+def read_cluster_file(path: str) -> List[List[str]]:
+    """Parse a cluster-definition TSV back into clusters of paths.
+
+    A line whose rep == member starts a new cluster (reference:
+    src/cluster_validation.rs:80-113).
+    """
+    clusters: List[List[str]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            rep, member = line.split("\t")
+            if rep == member:
+                clusters.append([member])
+            else:
+                if not clusters:
+                    raise ValueError(
+                        f"malformed cluster file {path}: member line "
+                        "before any representative line")
+                clusters[-1].append(member)
+    return clusters

@@ -1,0 +1,89 @@
+"""Device-mesh helpers: the distributed execution layer.
+
+The reference's only parallel runtime is a rayon thread pool over shared
+memory (reference: src/cluster_argument_parsing.rs:409-412 and the
+par_iter sites catalogued in SURVEY.md §2.3). The TPU-native equivalent is
+a JAX device mesh: the sketch matrix is sharded by genome row, each device
+computes its row block of the pair matrix against (replicated or
+all-gathered) columns, and XLA collectives reduce the results over ICI.
+Multi-host scale-out uses the same code path — `jax.distributed.initialize`
+plus a bigger mesh — since shard_map is SPMD over whatever mesh it's given.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_name: str = "i") -> Mesh:
+    """1-D mesh over the first n (default: all) local devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def sharded_pair_count(
+    sketch_mat: np.ndarray,
+    k: int,
+    min_ani: float,
+    mesh: Mesh,
+    col_tile: int = 64,
+) -> int:
+    """Count i<j sketch pairs with ANI >= min_ani, fully on-mesh.
+
+    One SPMD program: rows sharded over the mesh axis, per-device tile
+    loop over all columns, upper-triangle mask via global row/col ids,
+    and a `psum` over ICI producing the replicated global count. This is
+    the collective-reduction pattern the bigger pipelines reuse (and what
+    dryrun_multichip exercises on a virtual mesh).
+    """
+    from galah_tpu.ops.constants import SENTINEL
+    from galah_tpu.ops.pairwise import ani_to_jaccard, tile_stats
+
+    n = sketch_mat.shape[0]
+    n_dev = mesh.devices.size
+    import math
+
+    quantum = math.lcm(n_dev, col_tile)
+    pad_n = -(-n // quantum) * quantum
+    mat = np.full((pad_n, sketch_mat.shape[1]), np.uint64(SENTINEL),
+                  dtype=np.uint64)
+    mat[:n] = sketch_mat
+    j_thr = jnp.float32(ani_to_jaccard(min_ani, k))
+    sketch_size = sketch_mat.shape[1]
+
+    def spmd(rows_block, all_cols):
+        block = rows_block.shape[0]
+        row0 = jax.lax.axis_index("i") * block
+        n_tiles = all_cols.shape[0] // col_tile
+
+        def one_tile(t):
+            cols = jax.lax.dynamic_slice_in_dim(
+                all_cols, t * col_tile, col_tile, axis=0)
+            common, total = tile_stats(rows_block, cols, sketch_size, k)
+            passing = (common.astype(jnp.float32)
+                       >= j_thr * total.astype(jnp.float32))
+            passing = passing & (common > 0)
+            gi = row0 + jnp.arange(block)[:, None]
+            gj = t * col_tile + jnp.arange(col_tile)[None, :]
+            mask = (gi < gj) & (gj < n) & (gi < n)
+            return jnp.sum((passing & mask).astype(jnp.int32))
+
+        local = jnp.sum(jax.lax.map(one_tile, jnp.arange(n_tiles)))
+        return jax.lax.psum(local, "i")
+
+    fn = shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P("i", None), P(None, None)),
+        out_specs=P(),
+    )
+    return int(jax.jit(fn)(jnp.asarray(mat), jnp.asarray(mat)))

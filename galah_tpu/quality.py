@@ -1,0 +1,207 @@
+"""Genome quality: parsers, formulas, filtering, and ordering.
+
+Covers the reference's quality layer (reference:
+src/cluster_argument_parsing.rs:576-894 plus the checkm crate surface it
+consumes, and src/genome_info_file.rs:20-80):
+
+  * three input formats — CheckM1 tab table, CheckM2 quality report,
+    dRep-style genomeInfo CSV — all keyed by FASTA basename stem;
+  * completeness/contamination stored as fractions (inputs are 0-100);
+  * min-completeness / max-contamination filtering;
+  * four quality formulas ordering genomes descending:
+      - Parks2020_reduced (default):
+          comp*100 - 5*cont*100 - 5*num_contigs/100 - 5*num_ambiguous/1e5
+      - completeness-4contamination: comp - 4*cont
+      - completeness-5contamination: comp - 5*cont
+      - dRep: comp*100 - 5*cont*100 + cont*strain_het + 0.5*log10(N50)
+        (CheckM1 only — needs strain heterogeneity;
+         reference: src/cluster_argument_parsing.rs:780-812)
+
+Ties keep input order (stable sort), matching the reference's stable
+`sort_by` on the appraisal list.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import logging
+import math
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+from galah_tpu.io.fasta import GenomeStats, calculate_genome_stats
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class GenomeQuality:
+    completeness: float                # fraction 0-1
+    contamination: float               # fraction 0-1
+    strain_heterogeneity: Optional[float] = None  # raw 0-100, CheckM1 only
+
+
+QualityTable = Dict[str, GenomeQuality]
+
+
+def fasta_stem(path: str) -> str:
+    """Basename minus the last extension — the quality-table key
+    (mirrors the checkm crate's retrieve_via_fasta_path)."""
+    return os.path.splitext(os.path.basename(path))[0]
+
+
+def _read_quality_tsv(path: str, kind: str, name_header: str,
+                      het_header: Optional[str]) -> QualityTable:
+    """Shared TSV quality-table reader: columns by header name, duplicate
+    genome names rejected, percentages stored as fractions."""
+    out: QualityTable = {}
+    with open(path, newline="") as fh:
+        reader = csv.reader(fh, delimiter="\t")
+        header = next(reader, None)
+        if header is None:
+            raise ValueError(f"empty {kind} {path}")
+        try:
+            name_col = header.index(name_header)
+            comp_col = header.index("Completeness")
+            cont_col = header.index("Contamination")
+        except ValueError as e:
+            raise ValueError(
+                f"malformed {kind} header in {path}: {e}") from e
+        het_col = (header.index(het_header)
+                   if het_header and het_header in header else None)
+        for row in reader:
+            if not row:
+                continue
+            name = row[name_col]
+            if name in out:
+                raise ValueError(
+                    f"The genome {name} was found multiple times in the "
+                    f"checkm file {path}")
+            out[name] = GenomeQuality(
+                completeness=float(row[comp_col]) / 100.0,
+                contamination=float(row[cont_col]) / 100.0,
+                strain_heterogeneity=(
+                    float(row[het_col]) if het_col is not None else None),
+            )
+    logger.debug("Read %d genomes from %s", len(out), path)
+    return out
+
+
+def read_checkm1_tab_table(path: str) -> QualityTable:
+    """CheckM v1 `checkm qa` tab table: columns located by header name
+    (Bin Id / Completeness / Contamination / Strain heterogeneity)."""
+    return _read_quality_tsv(path, "CheckM tab table", "Bin Id",
+                             "Strain heterogeneity")
+
+
+def read_checkm2_quality_report(path: str) -> QualityTable:
+    """CheckM2 quality_report.tsv: Name / Completeness / Contamination."""
+    return _read_quality_tsv(path, "CheckM2 quality report", "Name", None)
+
+
+def read_genome_info_file(path: str) -> QualityTable:
+    """dRep-style CSV: exactly genome,completeness,contamination headers
+    (reference: src/genome_info_file.rs:20-80)."""
+    out: QualityTable = {}
+    with open(path, newline="") as fh:
+        reader = csv.reader(fh)
+        header = next(reader, None)
+        if header != ["genome", "completeness", "contamination"]:
+            raise ValueError("Incorrect headers found in genomeInfo file")
+        for row in reader:
+            if not row:
+                continue
+            if len(row) != 3:
+                raise ValueError(
+                    "Parsing error in genomeInfo file - didn't find 3 "
+                    f"columns in line {row!r}")
+            name = row[0]
+            if name in out:
+                raise ValueError(
+                    f"The genome {name} was found multiple times in the "
+                    f"checkm file {path}")
+            out[name] = GenomeQuality(
+                completeness=float(row[1]) / 100.0,
+                contamination=float(row[2]) / 100.0,
+            )
+    return out
+
+
+def retrieve(table: QualityTable, fasta_path: str) -> GenomeQuality:
+    stem = fasta_stem(fasta_path)
+    try:
+        return table[stem]
+    except KeyError:
+        raise KeyError(
+            f"Failed to find CheckM statistics for {fasta_path}") from None
+
+
+def filter_and_order_genomes(
+    genome_paths: Sequence[str],
+    table: QualityTable,
+    formula: str = "Parks2020_reduced",
+    min_completeness: Optional[float] = None,   # fraction
+    max_contamination: Optional[float] = None,  # fraction
+    stats_fn: Callable[[str], GenomeStats] = calculate_genome_stats,
+    threads: int = 1,
+) -> List[str]:
+    """Filter by quality thresholds, then order descending by formula.
+
+    `stats_fn` computes assembly stats for the formulas that need them
+    (Parks2020_reduced, dRep); injectable for tests. With threads > 1,
+    stats are computed concurrently (the reference fans this out over its
+    rayon pool, reference: src/cluster_argument_parsing.rs:853-894).
+    """
+    kept: List[str] = []
+    for p in genome_paths:
+        q = retrieve(table, p)
+        if min_completeness is not None and q.completeness < min_completeness:
+            continue
+        if max_contamination is not None and q.contamination > max_contamination:
+            continue
+        kept.append(p)
+
+    stats_cache: Dict[str, GenomeStats] = {}
+    if formula in ("Parks2020_reduced", "dRep") and threads > 1 and kept:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            for p, s in zip(kept, pool.map(stats_fn, kept)):
+                stats_cache[p] = s
+
+    def get_stats(p: str) -> GenomeStats:
+        if p not in stats_cache:
+            stats_cache[p] = stats_fn(p)
+        return stats_cache[p]
+
+    def score(p: str) -> float:
+        q = retrieve(table, p)
+        if formula == "completeness-4contamination":
+            return q.completeness - 4.0 * q.contamination
+        if formula == "completeness-5contamination":
+            return q.completeness - 5.0 * q.contamination
+        if formula == "Parks2020_reduced":
+            s = get_stats(p)
+            return (q.completeness * 100.0
+                    - 5.0 * q.contamination * 100.0
+                    - 5.0 * s.num_contigs / 100.0
+                    - 5.0 * s.num_ambiguous_bases / 100000.0)
+        if formula == "dRep":
+            if q.strain_heterogeneity is None:
+                raise ValueError(
+                    "dRep quality formula only works with CheckM v1 "
+                    "quality scoring since it includes strain heterogeneity")
+            s = get_stats(p)
+            return (q.completeness * 100.0
+                    - 5.0 * q.contamination * 100.0
+                    + q.contamination * q.strain_heterogeneity
+                    + 0.5 * math.log10(max(s.n50, 1)))
+        raise ValueError(f"unknown quality formula {formula!r}")
+
+    scored = [(p, score(p)) for p in kept]
+    scored.sort(key=lambda t: -t[1])  # stable: ties keep input order
+    logger.info(
+        "Read in genome qualities for %d genomes. %d passed quality "
+        "thresholds", len(table), len(scored))
+    return [p for p, _ in scored]

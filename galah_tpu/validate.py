@@ -1,0 +1,60 @@
+"""`cluster-validate`: re-check a cluster file with exact ANI.
+
+Mirrors the reference's cluster_validation.rs:7-78: every member must be
+within the ANI threshold of its representative, and every representative
+pair must be BELOW the threshold (or gated out). Violations are logged as
+errors; like the reference, validation does not exit nonzero on violation
+— the count is returned for callers/tests.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import List, Sequence
+
+from galah_tpu.backends.base import ClusterBackend
+from galah_tpu.outputs import read_cluster_file
+
+logger = logging.getLogger(__name__)
+
+
+def validate_clusters(
+    cluster_file: str,
+    clusterer: ClusterBackend,
+) -> int:
+    """Validate; returns the number of violations found."""
+    clusters = read_cluster_file(cluster_file)
+    thr = clusterer.ani_threshold
+    violations = 0
+
+    # members vs their rep
+    member_pairs = [
+        (cluster[0], member)
+        for cluster in clusters
+        for member in cluster[1:]
+    ]
+    anis = clusterer.calculate_ani_batch(member_pairs)
+    for (rep, member), ani in zip(member_pairs, anis):
+        if ani is None or ani < thr:
+            violations += 1
+            logger.error(
+                "Member %s is not within %s ANI of its representative %s "
+                "(found %s)", member, thr, rep, ani)
+
+    # rep pairs must NOT match
+    reps = [c[0] for c in clusters]
+    rep_pairs = list(itertools.combinations(reps, 2))
+    anis = clusterer.calculate_ani_batch(rep_pairs)
+    for (r1, r2), ani in zip(rep_pairs, anis):
+        if ani is not None and ani >= thr:
+            violations += 1
+            logger.error(
+                "Representatives %s and %s are within %s ANI of each "
+                "other (found %s)", r1, r2, thr, ani)
+
+    if violations == 0:
+        logger.info("Validated %d clusters: no violations", len(clusters))
+    else:
+        logger.error("Found %d validation violations", violations)
+    return violations

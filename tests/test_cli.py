@@ -1,0 +1,227 @@
+"""CLI integration tests with golden outputs.
+
+Mirrors the reference's assert_cli suite (reference:
+tests/test_cmdline.rs:8-338) through `galah_tpu.cli.main` in-process —
+the fixture genomes and expected cluster compositions are the same; the
+line ORDER follows this framework's deterministic precluster-size-then-
+rep ordering (the reference's order is thread-timing dependent).
+"""
+
+import os
+
+import pytest
+
+from galah_tpu.cli import main
+
+DATA = "/root/reference/tests/data"
+
+
+def _run(args):
+    return main(args)
+
+
+def test_completeness_4contamination_quality_score(tmp_path):
+    out = tmp_path / "clusters.tsv"
+    rc = _run([
+        "cluster", "--quality-formula", "completeness-4contamination",
+        "--genome-fasta-files",
+        f"{DATA}/abisko4/73.20120800_S1D.21.fna",
+        f"{DATA}/abisko4/73.20110800_S2M.16.fna",
+        "--precluster-method", "finch",
+        "--output-cluster-definition", str(out),
+        "--checkm-tab-table", f"{DATA}/abisko4/abisko4.csv",
+    ])
+    assert rc == 0
+    assert out.read_text() == (
+        f"{DATA}/abisko4/73.20120800_S1D.21.fna\t"
+        f"{DATA}/abisko4/73.20120800_S1D.21.fna\n"
+        f"{DATA}/abisko4/73.20120800_S1D.21.fna\t"
+        f"{DATA}/abisko4/73.20110800_S2M.16.fna\n")
+
+
+def test_parks2020_reduced_quality_score(tmp_path):
+    out = tmp_path / "clusters.tsv"
+    rc = _run([
+        "cluster", "--quality-formula", "Parks2020_reduced",
+        "--genome-fasta-files",
+        f"{DATA}/abisko4/73.20120800_S1D.21.fna",
+        f"{DATA}/abisko4/73.20110800_S2M.16.fna",
+        "--precluster-method", "finch",
+        "--output-cluster-definition", str(out),
+        "--checkm-tab-table", f"{DATA}/abisko4/abisko4.csv",
+    ])
+    assert rc == 0
+    assert out.read_text() == (
+        f"{DATA}/abisko4/73.20110800_S2M.16.fna\t"
+        f"{DATA}/abisko4/73.20110800_S2M.16.fna\n"
+        f"{DATA}/abisko4/73.20110800_S2M.16.fna\t"
+        f"{DATA}/abisko4/73.20120800_S1D.21.fna\n")
+
+
+def test_output_symlink_directory(tmp_path):
+    outdir = tmp_path / "reps"
+    rc = _run([
+        "cluster", "--quality-formula", "Parks2020_reduced",
+        "--genome-fasta-files",
+        f"{DATA}/set1/500kb.fna", f"{DATA}/set1/1mbp.fna",
+        "--precluster-method", "finch",
+        "--output-representative-fasta-directory", str(outdir),
+    ])
+    assert rc == 0
+    link = outdir / "500kb.fna"
+    assert link.is_symlink()
+    assert not (outdir / "1mbp.fna").exists()
+
+
+def test_output_symlink_directory_preexisting_empty(tmp_path):
+    outdir = tmp_path / "reps"
+    outdir.mkdir()
+    rc = _run([
+        "cluster",
+        "--genome-fasta-files",
+        f"{DATA}/set1/500kb.fna", f"{DATA}/set1/1mbp.fna",
+        "--precluster-method", "finch",
+        "--output-representative-fasta-directory", str(outdir),
+    ])
+    assert rc == 0
+    assert (outdir / "500kb.fna").is_symlink()
+
+
+def test_output_directory_names_clash_copy(tmp_path):
+    outdir = tmp_path / "reps"
+    rc = _run([
+        "cluster",
+        "--genome-fasta-files",
+        f"{DATA}/set1_name_clash/500kb.fna",
+        f"{DATA}/set1/500kb.fna",
+        f"{DATA}/set1/1mbp.fna",
+        "--precluster-method", "finch",
+        "--output-representative-fasta-directory-copy", str(outdir),
+    ])
+    assert rc == 0
+    assert (outdir / "500kb.fna").exists()
+    assert not (outdir / "500kb.fna").is_symlink()
+    assert (outdir / "500kb.fna.1.fna").exists()
+    assert not (outdir / "1mbp.fna").exists()
+
+
+def test_output_representative_list(tmp_path):
+    out = tmp_path / "reps.txt"
+    rc = _run([
+        "cluster",
+        "--genome-fasta-files",
+        f"{DATA}/set1_name_clash/500kb.fna",
+        f"{DATA}/set1/500kb.fna",
+        f"{DATA}/set1/1mbp.fna",
+        "--precluster-method", "finch",
+        "--output-representative-list", str(out),
+    ])
+    assert rc == 0
+    # biggest precluster first: {set1/500kb, 1mbp} then the clash genome
+    assert out.read_text() == (
+        f"{DATA}/set1/500kb.fna\n{DATA}/set1_name_clash/500kb.fna\n")
+
+
+def test_min_aligned_fraction(tmp_path):
+    """Reference: tests/test_cmdline.rs:216-255 — 0.2 clusters the
+    half-aligned pair, 0.6 splits it."""
+    out = tmp_path / "reps.txt"
+    rc = _run([
+        "cluster",
+        "--genome-fasta-files",
+        f"{DATA}/set2/1mbp.fna", f"{DATA}/set2/1mbp.half_aligned.fna",
+        "--min-aligned-fraction", "0.2",
+        "--precluster-method", "finch",
+        "--output-representative-list", str(out),
+    ])
+    assert rc == 0
+    assert out.read_text() == f"{DATA}/set2/1mbp.fna\n"
+
+    out2 = tmp_path / "reps2.txt"
+    rc = _run([
+        "cluster",
+        "--genome-fasta-files",
+        f"{DATA}/set2/1mbp.fna", f"{DATA}/set2/1mbp.half_aligned.fna",
+        "--min-aligned-fraction", "0.6",
+        "--precluster-method", "finch",
+        "--output-representative-list", str(out2),
+    ])
+    assert rc == 0
+    assert out2.read_text() == (
+        f"{DATA}/set2/1mbp.fna\n{DATA}/set2/1mbp.half_aligned.fna\n")
+
+
+def test_github7_aligned_fraction_semantics(tmp_path):
+    """Reference regression for galah issue #7
+    (tests/test_cmdline.rs:316-338): the antonio MAG pair clusters at
+    min-aligned-fraction 60."""
+    out = tmp_path / "reps.txt"
+    rc = _run([
+        "cluster",
+        "--genome-fasta-files",
+        f"{DATA}/antonio_mags/BE_RX_R2_MAG52.fna",
+        f"{DATA}/antonio_mags/BE_RX_R3_MAG189.fna",
+        "--precluster-method", "finch",
+        "--precluster-ani", "90", "--ani", "95",
+        "--min-aligned-fraction", "60",
+        "--output-representative-list", str(out),
+    ])
+    assert rc == 0
+    assert out.read_text() == f"{DATA}/antonio_mags/BE_RX_R2_MAG52.fna\n"
+
+
+def test_skani_skani_precluster_threshold_override(tmp_path):
+    """Reference: tests/test_cmdline.rs test_skani_skani_clusterer —
+    with skani+skani, --precluster-ani 99 is overridden by --ani 95 and
+    all four MAGs land in one cluster."""
+    out = tmp_path / "clusters.tsv"
+    rc = _run([
+        "cluster",
+        "--genome-fasta-files",
+        f"{DATA}/abisko4/73.20120800_S1X.13.fna",
+        f"{DATA}/abisko4/73.20120600_S2D.19.fna",
+        f"{DATA}/abisko4/73.20120700_S3X.12.fna",
+        f"{DATA}/abisko4/73.20110800_S2D.13.fna",
+        "--precluster-method", "skani", "--cluster-method", "skani",
+        "--precluster-ani", "99", "--ani", "95",
+        "--output-cluster-definition", str(out),
+        "--checkm-tab-table", f"{DATA}/abisko4/abisko4.csv",
+    ])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert len(lines) == 4
+    rep = f"{DATA}/abisko4/73.20120800_S1X.13.fna"
+    assert all(line.startswith(rep + "\t") for line in lines)
+
+
+def test_cluster_validate_roundtrip(tmp_path):
+    clusters = tmp_path / "clusters.tsv"
+    rc = _run([
+        "cluster",
+        "--genome-fasta-files",
+        f"{DATA}/set1/500kb.fna", f"{DATA}/set1/1mbp.fna",
+        "--precluster-method", "finch", "--cluster-method", "fastani",
+        "--output-cluster-definition", str(clusters),
+    ])
+    assert rc == 0
+    rc = _run([
+        "cluster-validate", "--cluster-file", str(clusters),
+        "--ani", "95", "--min-aligned-fraction", "20",
+    ])
+    assert rc == 0
+
+
+def test_no_genome_input_errors():
+    rc = _run(["cluster", "--output-representative-list", "/dev/null"])
+    assert rc == 1
+
+
+def test_missing_quality_entry_clean_error(tmp_path):
+    info = tmp_path / "info.csv"
+    info.write_text("genome,completeness,contamination\nother,90,1\n")
+    rc = _run([
+        "cluster", "-f", f"{DATA}/set1/500kb.fna",
+        "--genome-info", str(info),
+        "--quality-formula", "completeness-4contamination",
+    ])
+    assert rc == 1

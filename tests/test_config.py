@@ -1,0 +1,38 @@
+"""Config: percentage normalization boundary semantics.
+
+Reference: src/cluster_argument_parsing.rs:1160-1182 — [1, 100] is
+percent (1 means 1%), [0, 1) is already a fraction, outside is an error.
+"""
+
+import pytest
+
+from galah_tpu.config import ClusterConfig, parse_percentage
+
+
+def test_percent_range():
+    assert parse_percentage(95) == pytest.approx(0.95)
+    assert parse_percentage(100) == pytest.approx(1.0)
+    assert parse_percentage(1.0) == pytest.approx(0.01)  # 1 means 1%!
+    assert parse_percentage(15) == pytest.approx(0.15)
+
+
+def test_fraction_range():
+    assert parse_percentage(0.95) == pytest.approx(0.95)
+    assert parse_percentage(0.0) == 0.0
+    assert parse_percentage(0.999) == pytest.approx(0.999)
+
+
+def test_out_of_range():
+    with pytest.raises(ValueError):
+        parse_percentage(150)
+    with pytest.raises(ValueError):
+        parse_percentage(-1)
+
+
+def test_cluster_config_validates_methods():
+    with pytest.raises(ValueError, match="precluster"):
+        ClusterConfig(precluster_method="nope")
+    with pytest.raises(ValueError, match="cluster method"):
+        ClusterConfig(cluster_method="nope")
+    with pytest.raises(ValueError, match="quality formula"):
+        ClusterConfig(quality_formula="nope")

@@ -1,0 +1,115 @@
+"""Quality layer: parsers, formulas, filtering, ordering."""
+
+import pytest
+
+from galah_tpu.io.fasta import GenomeStats
+from galah_tpu import quality
+
+
+def test_read_genome_info(ref_data):
+    table = quality.read_genome_info_file(
+        str(ref_data / "set1" / "genomeInfo.csv"))
+    assert table["1mbp"].completeness == pytest.approx(1.0)
+    assert table["1mbp"].contamination == pytest.approx(0.0)
+    assert table["500kb"].completeness == pytest.approx(0.5)
+    assert table["500kb"].contamination == pytest.approx(0.01)
+
+
+def test_read_genome_info_bad_headers(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("genome,comp,cont\na,1,2\n")
+    with pytest.raises(ValueError, match="Incorrect headers"):
+        quality.read_genome_info_file(str(p))
+
+
+def test_read_genome_info_duplicate(tmp_path):
+    p = tmp_path / "dup.csv"
+    p.write_text("genome,completeness,contamination\na,90,1\na,80,2\n")
+    with pytest.raises(ValueError, match="multiple times"):
+        quality.read_genome_info_file(str(p))
+
+
+def test_read_checkm1(ref_data):
+    table = quality.read_checkm1_tab_table(
+        str(ref_data / "abisko4" / "abisko4.csv"))
+    q = table["73.20110600_S2D.10"]
+    assert q.completeness == pytest.approx(0.7854)
+    assert q.contamination == pytest.approx(0.0065)
+    assert q.strain_heterogeneity == pytest.approx(0.0)
+    q2 = table["73.20110600_S3M.17"]
+    assert q2.strain_heterogeneity == pytest.approx(33.33)
+
+
+def test_read_checkm2(tmp_path):
+    p = tmp_path / "quality_report.tsv"
+    p.write_text("Name\tCompleteness\tContamination\tSomething\n"
+                 "g1\t95.5\t2.5\tx\n")
+    table = quality.read_checkm2_quality_report(str(p))
+    assert table["g1"].completeness == pytest.approx(0.955)
+    assert table["g1"].contamination == pytest.approx(0.025)
+    assert table["g1"].strain_heterogeneity is None
+
+
+def test_retrieve_by_stem():
+    table = {"g1": quality.GenomeQuality(0.9, 0.01)}
+    assert quality.retrieve(table, "/some/dir/g1.fna").completeness == 0.9
+    with pytest.raises(KeyError, match="Failed to find CheckM statistics"):
+        quality.retrieve(table, "/some/dir/g2.fna")
+
+
+def _stats(mapping):
+    return lambda p: mapping[p]
+
+
+def test_formula_flip_4contamination_vs_parks(ref_data):
+    """The reference's CLI goldens: completeness-4contamination ranks
+    S1D.21 (95.21/0.00) above S2M.16 (95.92/0.65); Parks2020_reduced
+    flips the order (reference: tests/test_cmdline.rs:8-57)."""
+    table = quality.read_checkm1_tab_table(
+        str(ref_data / "abisko4" / "abisko4.csv"))
+    g1 = str(ref_data / "abisko4" / "73.20120800_S1D.21.fna")
+    g2 = str(ref_data / "abisko4" / "73.20110800_S2M.16.fna")
+
+    out4 = quality.filter_and_order_genomes(
+        [g1, g2], table, formula="completeness-4contamination")
+    assert out4 == [g1, g2]
+
+    outp = quality.filter_and_order_genomes(
+        [g1, g2], table, formula="Parks2020_reduced")
+    assert outp == [g2, g1]
+
+
+def test_min_completeness_filter():
+    table = {
+        "a": quality.GenomeQuality(0.9, 0.01),
+        "b": quality.GenomeQuality(0.5, 0.01),
+        "c": quality.GenomeQuality(0.95, 0.2),
+    }
+    out = quality.filter_and_order_genomes(
+        ["a.fna", "b.fna", "c.fna"], table,
+        formula="completeness-4contamination",
+        min_completeness=0.7, max_contamination=0.1)
+    assert out == ["a.fna"]
+
+
+def test_drep_formula_requires_heterogeneity():
+    table = {"a": quality.GenomeQuality(0.9, 0.01)}
+    with pytest.raises(ValueError, match="dRep quality formula"):
+        quality.filter_and_order_genomes(
+            ["a.fna"], table, formula="dRep",
+            stats_fn=_stats({"a.fna": GenomeStats(1, 0, 1000)}))
+
+
+def test_drep_formula_score_order():
+    table = {
+        "a": quality.GenomeQuality(0.9, 0.05, strain_heterogeneity=100.0),
+        "b": quality.GenomeQuality(0.9, 0.05, strain_heterogeneity=0.0),
+    }
+    stats = _stats({
+        "a.fna": GenomeStats(10, 0, 10000),
+        "b.fna": GenomeStats(10, 0, 10000),
+    })
+    # higher heterogeneity discounts contamination -> a scores higher
+    out = quality.filter_and_order_genomes(
+        ["b.fna", "a.fna"], table, formula="dRep", stats_fn=stats)
+    assert out == ["a.fna", "b.fna"]
