@@ -290,9 +290,14 @@ def main(argv=None) -> int:
             return run_cluster(args)
         else:
             return run_cluster_validate(args)
-    except (ValueError, FileNotFoundError, KeyError) as e:
-        # expected user errors: clean message, nonzero exit, no traceback
-        logger.error("%s", e.args[0] if e.args else e)
+    except (ValueError, OSError, KeyError) as e:
+        # expected user errors: clean message, nonzero exit, no traceback.
+        # str(e) for OS errors (args[0] would be the bare errno); args[0]
+        # for KeyError/ValueError (str(KeyError) quotes the repr).
+        if isinstance(e, OSError):
+            logger.error("%s", e)
+        else:
+            logger.error("%s", e.args[0] if e.args else e)
         return 1
 
 

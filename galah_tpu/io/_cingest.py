@@ -1,0 +1,98 @@
+"""ctypes loader/builder for the native FASTA ingestion kernel.
+
+Compiles csrc/ingest.c into a shared library on first import (gcc/cc +
+zlib, both part of the baked-in toolchain) and exposes
+
+    read_fasta(path) -> (codes uint8[L], offsets int64[C+1],
+                         num_ambiguous, n50)
+
+which is the contract galah_tpu.io.fasta expects from its C fast path.
+Any build/load failure raises ImportError so fasta.py silently falls back
+to the numpy parser; set GALAH_TPU_NO_CINGEST=1 to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import sysconfig
+
+import numpy as np
+
+if os.environ.get("GALAH_TPU_NO_CINGEST"):
+    raise ImportError("native ingestion disabled via GALAH_TPU_NO_CINGEST")
+
+_PKG_DIR = pathlib.Path(__file__).resolve().parent
+_SRC = _PKG_DIR.parent.parent / "csrc" / "ingest.c"
+_SOSUFFIX = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+_LIB = _PKG_DIR / f"_libingest{_SOSUFFIX}"
+
+
+def _build() -> None:
+    if not _SRC.is_file():
+        raise ImportError(f"native ingestion source missing: {_SRC}")
+    if _LIB.is_file() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return
+    cc = os.environ.get("CC", "cc")
+    cmd = [cc, "-O3", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC), "-lz"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise ImportError(f"native ingestion build failed to run: {e}")
+    if proc.returncode != 0:
+        raise ImportError(
+            f"native ingestion build failed: {' '.join(cmd)}\n{proc.stderr}")
+
+
+class _GalahGenome(ctypes.Structure):
+    _fields_ = [
+        ("codes", ctypes.POINTER(ctypes.c_uint8)),
+        ("total_len", ctypes.c_int64),
+        ("offsets", ctypes.POINTER(ctypes.c_int64)),
+        ("n_contigs", ctypes.c_int64),
+        ("num_ambiguous", ctypes.c_int64),
+        ("n50", ctypes.c_int64),
+    ]
+
+
+_build()
+try:
+    _dll = ctypes.CDLL(str(_LIB))
+except OSError as e:
+    raise ImportError(f"native ingestion library failed to load: {e}")
+
+_dll.galah_read_fasta.argtypes = [ctypes.c_char_p,
+                                  ctypes.POINTER(_GalahGenome)]
+_dll.galah_read_fasta.restype = ctypes.c_int
+_dll.galah_free_genome.argtypes = [ctypes.POINTER(_GalahGenome)]
+_dll.galah_free_genome.restype = None
+
+_ERRORS = {
+    -1: "could not open file",
+    -2: "no FASTA records found",
+    -3: "out of memory",
+    -4: "read error (corrupt gzip?)",
+}
+
+
+def read_fasta(path: str):
+    """Parse a (possibly gzipped) FASTA natively; see module docstring."""
+    g = _GalahGenome()
+    rc = _dll.galah_read_fasta(os.fsencode(path), ctypes.byref(g))
+    if rc != 0:
+        raise ValueError(
+            f"{_ERRORS.get(rc, f'error {rc}')} in {path}")
+    try:
+        if g.total_len > 0:
+            codes = np.ctypeslib.as_array(
+                g.codes, shape=(g.total_len,)).copy()
+        else:
+            codes = np.zeros(0, dtype=np.uint8)
+        offsets = np.ctypeslib.as_array(
+            g.offsets, shape=(g.n_contigs + 1,)).copy()
+        return codes, offsets, int(g.num_ambiguous), int(g.n50)
+    finally:
+        _dll.galah_free_genome(ctypes.byref(g))

@@ -15,7 +15,6 @@ import dataclasses
 import logging
 import os
 import shutil
-import sys
 from typing import List, Optional, Sequence, TextIO
 
 logger = logging.getLogger(__name__)
@@ -36,13 +35,13 @@ def _setup_directory(path: Optional[str], argument: str) -> Optional[str]:
         return None
     if os.path.exists(path):
         if not os.path.isdir(path):
-            logger.error("The %s path specified (%s) exists but is not a "
-                         "directory", argument, path)
-            sys.exit(1)
+            raise ValueError(
+                f"The {argument} path specified ({path}) exists but is "
+                "not a directory")
         if os.listdir(path):
-            logger.error("The %s specified (%s) exists and is not empty",
-                         argument, path)
-            sys.exit(1)
+            raise ValueError(
+                f"The {argument} specified ({path}) exists and is not "
+                "empty")
         logger.info("Using pre-existing but empty %s", argument)
     else:
         logger.info("Creating %s ..", argument)

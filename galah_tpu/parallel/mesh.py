@@ -12,7 +12,6 @@ plus a bigger mesh — since shard_map is SPMD over whatever mesh it's given.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

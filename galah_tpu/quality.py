@@ -70,9 +70,15 @@ def _read_quality_tsv(path: str, kind: str, name_header: str,
                 f"malformed {kind} header in {path}: {e}") from e
         het_col = (header.index(het_header)
                    if het_header and het_header in header else None)
+        min_cols = max(name_col, comp_col, cont_col,
+                       het_col if het_col is not None else 0) + 1
         for row in reader:
             if not row:
                 continue
+            if len(row) < min_cols:
+                raise ValueError(
+                    f"malformed {kind} row in {path}: expected at least "
+                    f"{min_cols} columns, got {len(row)}: {row!r}")
             name = row[name_col]
             if name in out:
                 raise ValueError(

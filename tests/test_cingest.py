@@ -1,0 +1,96 @@
+"""Native ingestion kernel: build, golden stats, parity with numpy parser.
+
+Goldens are the reference's (reference: src/genome_stats.rs:61-87): the
+abisko4 MAG 73.20110600_S2D.10 has 161 contigs, 6506 ambiguous bases,
+N50 8289.
+"""
+
+import gzip
+import importlib
+
+import numpy as np
+import pytest
+
+from galah_tpu.io import fasta
+
+
+@pytest.fixture(scope="module")
+def cingest():
+    try:
+        return importlib.import_module("galah_tpu.io._cingest")
+    except ImportError as e:
+        pytest.fail(f"native ingestion kernel failed to build: {e}")
+
+
+def _numpy_read(path):
+    """Force the pure-numpy reference parse regardless of the C path."""
+    import unittest.mock
+
+    with unittest.mock.patch.dict(
+            "sys.modules", {"galah_tpu.io._cingest": None}):
+        return fasta.read_genome(str(path))
+
+
+def _assert_parity(cingest, path):
+    ref = _numpy_read(path)
+    codes, offsets, n_amb, n50 = cingest.read_fasta(str(path))
+    np.testing.assert_array_equal(codes, ref.codes)
+    np.testing.assert_array_equal(offsets, ref.contig_offsets)
+    assert n_amb == ref.stats.num_ambiguous_bases
+    assert n50 == ref.stats.n50
+    assert offsets.shape[0] - 1 == ref.stats.num_contigs
+
+
+def test_golden_stats_native(cingest, ref_data):
+    path = ref_data / "abisko4" / "73.20110600_S2D.10.fna"
+    _, offsets, n_amb, n50 = cingest.read_fasta(str(path))
+    assert offsets.shape[0] - 1 == 161
+    assert n_amb == 6506
+    assert n50 == 8289
+
+
+def test_parity_reference_fixtures(cingest, ref_data):
+    for rel in ["abisko4/73.20110600_S2D.10.fna",
+                "set1/1mbp.fna",
+                "set1/500kb.fna"]:
+        _assert_parity(cingest, ref_data / rel)
+
+
+def test_parity_edge_cases(cingest, tmp_path):
+    cases = {
+        "plain.fna": b">a\nACGT\nNNacgt\n>b\nTTTT\n",
+        "crlf.fna": b">a desc\r\nAC GT\r\n\r\n>b\r\nNN\r\n",
+        "leading_junk.fna": b"ACGT\n>a\nACGT\n",
+        "empty_contig.fna": b">a\n>b\nACGT\n",
+        "no_trailing_newline.fna": b">a\nACGTAC",
+        "indented_header.fna": b"  >a\nACGT\n  >b\nTT\n",
+    }
+    for name, content in cases.items():
+        p = tmp_path / name
+        p.write_bytes(content)
+        _assert_parity(cingest, p)
+
+
+def test_parity_gzip(cingest, tmp_path):
+    p = tmp_path / "g.fna.gz"
+    with gzip.open(p, "wb") as fh:
+        fh.write(b">a\nACGTN\n>b\nacgtacgt\n")
+    _assert_parity(cingest, p)
+
+
+def test_no_records_native(cingest, tmp_path):
+    p = tmp_path / "empty.fna"
+    p.write_bytes(b"\n\n")
+    with pytest.raises(ValueError):
+        cingest.read_fasta(str(p))
+
+
+def test_read_genome_uses_c_path(ref_data):
+    """read_genome must produce identical results whether or not the C
+    fast path is active (it is active here if the build succeeded)."""
+    path = str(ref_data / "set1" / "500kb.fna")
+    g = fasta.read_genome(path)
+    ref = _numpy_read(path)
+    np.testing.assert_array_equal(g.codes, ref.codes)
+    np.testing.assert_array_equal(g.contig_offsets, ref.contig_offsets)
+    assert g.stats == ref.stats
