@@ -2,6 +2,7 @@ from galah_tpu.backends.base import (  # noqa: F401
     ClusterBackend,
     PreclusterBackend,
 )
+from galah_tpu.backends.hll_backend import HLLPreclusterer  # noqa: F401
 from galah_tpu.backends.minhash_backend import MinHashPreclusterer  # noqa: F401
 from galah_tpu.backends.fragment_backend import (  # noqa: F401
     FastANIEquivalentClusterer,

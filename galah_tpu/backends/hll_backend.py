@@ -1,0 +1,56 @@
+"""HyperLogLog precluster backend — the dashing-equivalent.
+
+The reference spawns the dashing C++ binary and parses its full N x N
+distance matrix from stdout (reference: src/dashing.rs:11-100). Here the
+HLL sketches are built and compared on device (ops/hll.py); only the
+sparse thresholded pairs reach the host cache.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+from galah_tpu.backends.base import PreclusterBackend
+from galah_tpu.cluster.cache import PairDistanceCache
+from galah_tpu.config import Defaults
+from galah_tpu.ops import hll
+
+logger = logging.getLogger(__name__)
+
+
+class HLLPreclusterer(PreclusterBackend):
+    """All-pairs HLL Mash-ANI pass producing the sparse pair cache."""
+
+    def __init__(self, min_ani: float, p: int = hll.DEFAULT_P,
+                 k: int = Defaults.MINHASH_KMER,
+                 seed: int = Defaults.MINHASH_SEED) -> None:
+        self.min_ani = float(min_ani)
+        self.p = int(p)
+        self.k = int(k)
+        self.seed = int(seed)
+
+    def method_name(self) -> str:
+        return "dashing"
+
+    def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
+        import numpy as np
+
+        from galah_tpu.io.fasta import read_genome
+
+        n = len(genome_paths)
+        logger.info("Sketching HLL registers of %d genomes on device ..", n)
+        regs = np.zeros((n, 1 << self.p), dtype=np.uint8)
+        for i, path in enumerate(genome_paths):
+            regs[i] = hll.hll_sketch_genome(
+                read_genome(path), p=self.p, k=self.k, seed=self.seed)
+
+        logger.info("Computing tiled all-pairs HLL ANI ..")
+        pairs = hll.hll_threshold_pairs(regs, k=self.k,
+                                        min_ani=self.min_ani)
+        cache = PairDistanceCache()
+        for (i, j), ani in pairs.items():
+            cache.insert((i, j), ani)
+        logger.info("Found %d pairs passing precluster threshold %.4f",
+                    len(cache), self.min_ani)
+        return cache

@@ -134,6 +134,7 @@ def _build_backends(args, store=None):
     src/cluster_argument_parsing.rs:897-1158)."""
     from galah_tpu.backends import (
         FastANIEquivalentClusterer,
+        HLLPreclusterer,
         MinHashPreclusterer,
         ProfileStore,
         SkaniEquivalentClusterer,
@@ -165,12 +166,9 @@ def _build_backends(args, store=None):
             threshold=precluster_ani, min_aligned_fraction=min_af,
             store=store)
     elif args.precluster_method == "dashing":
-        # HyperLogLog subprocess backend in the reference; the device
-        # MinHash kernel covers its role here.
-        logger.warning(
-            "dashing precluster method maps to the device MinHash "
-            "(finch-equivalent) backend in this framework")
-        pre = MinHashPreclusterer(min_ani=precluster_ani)
+        # HyperLogLog subprocess backend in the reference; here a device
+        # HLL kernel (reference: src/dashing.rs:11-100).
+        pre = HLLPreclusterer(min_ani=precluster_ani)
     else:
         raise ValueError(args.precluster_method)
 

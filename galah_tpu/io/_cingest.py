@@ -36,15 +36,22 @@ def _build() -> None:
     if _LIB.is_file() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
         return
     cc = os.environ.get("CC", "cc")
-    cmd = [cc, "-O3", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC), "-lz"]
+    # Compile to a temp path and os.replace for an atomic publish, so
+    # concurrent importers never dlopen a half-written library.
+    tmp = _LIB.with_name(f"{_LIB.stem}.{os.getpid()}{_LIB.suffix}")
+    cmd = [cc, "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC), "-lz"]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
+        if proc.returncode != 0:
+            raise ImportError(
+                f"native ingestion build failed: "
+                f"{' '.join(cmd)}\n{proc.stderr}")
+        os.replace(tmp, _LIB)
     except (OSError, subprocess.TimeoutExpired) as e:
         raise ImportError(f"native ingestion build failed to run: {e}")
-    if proc.returncode != 0:
-        raise ImportError(
-            f"native ingestion build failed: {' '.join(cmd)}\n{proc.stderr}")
+    finally:
+        tmp.unlink(missing_ok=True)
 
 
 class _GalahGenome(ctypes.Structure):

@@ -23,12 +23,8 @@ def cingest():
 
 
 def _numpy_read(path):
-    """Force the pure-numpy reference parse regardless of the C path."""
-    import unittest.mock
-
-    with unittest.mock.patch.dict(
-            "sys.modules", {"galah_tpu.io._cingest": None}):
-        return fasta.read_genome(str(path))
+    """The pure-numpy reference parse, bypassing the C fast path."""
+    return fasta.read_genome_numpy(str(path))
 
 
 def _assert_parity(cingest, path):
