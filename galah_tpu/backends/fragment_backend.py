@@ -86,6 +86,15 @@ class _FragmentANIMixin:
         return fragment_ani.bidirectional_ani(
             pa, pb, min_aligned_frac=self.min_aligned_fraction)
 
+    def _batch_results(
+        self, pairs: Sequence[tuple[str, str]]
+    ) -> List[Optional[float]]:
+        """ANI for every path pair via coalesced device dispatches."""
+        profs = [(self.store.get(a), self.store.get(b)) for a, b in pairs]
+        results = fragment_ani.bidirectional_ani_batch(
+            profs, min_aligned_frac=self.min_aligned_fraction)
+        return [ani for ani, _, _ in results]
+
 
 class FastANIEquivalentClusterer(ClusterBackend, _FragmentANIMixin):
     def __init__(self, threshold: float, min_aligned_fraction: float,
@@ -109,11 +118,7 @@ class FastANIEquivalentClusterer(ClusterBackend, _FragmentANIMixin):
     def calculate_ani_batch(
         self, pairs: Sequence[tuple[str, str]]
     ) -> List[Optional[float]]:
-        out: List[Optional[float]] = []
-        for a, b in pairs:
-            ani, _, _ = self._pair_result(a, b)
-            out.append(ani)
-        return out
+        return self._batch_results(pairs)
 
 
 class SkaniEquivalentClusterer(ClusterBackend, _FragmentANIMixin):
@@ -135,11 +140,8 @@ class SkaniEquivalentClusterer(ClusterBackend, _FragmentANIMixin):
     ) -> List[Optional[float]]:
         # A gated-out pair is ANI 0.0, not None — the reference's skani
         # wrapper always returns Some (reference: src/skani.rs:126-129).
-        out: List[Optional[float]] = []
-        for a, b in pairs:
-            ani, _, _ = self._pair_result(a, b)
-            out.append(ani if ani is not None else 0.0)
-        return out
+        return [ani if ani is not None else 0.0
+                for ani in self._batch_results(pairs)]
 
 
 class SkaniPreclusterer(PreclusterBackend):
@@ -201,10 +203,10 @@ class SkaniPreclusterer(PreclusterBackend):
                     len(ii))
 
         cache = PairDistanceCache()
-        for i, j in zip(ii, jj):
-            ani, _, _ = fragment_ani.bidirectional_ani(
-                profiles[i], profiles[j],
-                min_aligned_frac=self.min_aligned_fraction)
+        results = fragment_ani.bidirectional_ani_batch(
+            [(profiles[i], profiles[j]) for i, j in zip(ii, jj)],
+            min_aligned_frac=self.min_aligned_fraction)
+        for i, j, (ani, _, _) in zip(ii, jj, results):
             if ani is not None and ani >= self.threshold:
                 cache.insert((i, j), ani)
         logger.info("Found %d pairs passing precluster threshold %.4f",

@@ -133,8 +133,11 @@ def hll_threshold_pairs(
     entries come back. The device-side analog of parsing dashing's full
     TSV matrix (reference: src/dashing.rs:76-100).
     """
+    import math
+
     n, m = regs_mat.shape
-    n_pad = -(-n // max(row_tile, col_tile)) * max(row_tile, col_tile)
+    quantum = math.lcm(row_tile, col_tile)
+    n_pad = -(-n // quantum) * quantum
     mat = np.zeros((n_pad, m), dtype=np.uint8)
     mat[:n] = regs_mat
     jmat = jnp.asarray(mat)

@@ -52,6 +52,26 @@ def test_union_and_jaccard():
     assert abs(u - 150_000) / 150_000 < 0.065
 
 
+def test_threshold_pairs_non_dividing_tiles():
+    """Tile sizes that don't divide the padded N must not mis-attribute
+    pairs (regression: dynamic_slice start clamping)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    n, p = 70, 10
+    mat = np.zeros((n, 1 << p), dtype=np.uint8)
+    for i in range(n):
+        h = rng.integers(0, 1 << 63, size=50_000, dtype=np.uint64) * 2 + 1
+        mat[i] = np.asarray(hll._hll_update(
+            jnp.zeros((1 << p,), dtype=jnp.uint8), jnp.asarray(h), p))
+    mat[69] = mat[16]  # identical pair at the tail
+    pairs = hll.hll_threshold_pairs(mat, k=21, min_ani=0.99,
+                                    row_tile=64, col_tile=80)
+    assert (16, 69) in pairs
+    ref = hll.hll_threshold_pairs(mat, k=21, min_ani=0.99)
+    assert set(pairs) == set(ref)
+
+
 def test_identical_sketch_ani_is_one():
     regs, _ = _random_regs(100_000, p=12, seed=3)
     mat = np.stack([regs, regs])
