@@ -1,13 +1,16 @@
-"""Benchmark: tiled all-pairs MinHash ANI throughput (genome-pairs/sec).
+"""Benchmark: all-pairs MinHash ANI throughput (genome-pairs/sec).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-The measured op is the framework's hot path — the device kernel replacing
-the reference's host O(N^2) sketch-compare loop (reference:
-src/finch.rs:53-73). `vs_baseline` is the speedup over the same
-merged-bottom-k computation run single-threaded on the host (numpy), the
-stand-in for the reference's CPU path (the reference publishes no numbers;
-see BASELINE.md).
+The measured op is the framework's hot path — the on-device all-pairs
+sketch comparison replacing the reference's host O(N^2) pair loop
+(reference: src/finch.rs:53-73). The whole N x N pass (pair stats,
+threshold, upper-triangle mask, count reduction) runs as ONE sharded
+device program (parallel/mesh.py: sharded_pair_count), so the number
+reflects device throughput rather than dispatch latency. `vs_baseline`
+is the speedup over the same merged-bottom-k computation single-threaded
+on the host (numpy) — the stand-in for the reference's CPU path (the
+reference publishes no numbers; see BASELINE.md).
 """
 
 import json
@@ -23,33 +26,25 @@ def _sketches(n, sketch_size, seed):
     return mat
 
 
-def bench_device(mat, k, sketch_size, row_tile=256, col_tile=256):
+def bench_device(mat, k, min_ani=0.95, col_tile=256, repeats=3):
     import jax
-    import jax.numpy as jnp
 
-    from galah_tpu.ops.pairwise import tile_ani
+    from galah_tpu.parallel import make_mesh, sharded_pair_count
 
+    mesh = make_mesh()
     n = mat.shape[0]
-    jmat = jax.device_put(jnp.asarray(mat))
-
-    def run():
-        acc = 0.0
-        for r0 in range(0, n, row_tile):
-            rows = jax.lax.dynamic_slice_in_dim(jmat, r0, row_tile, 0)
-            for c0 in range(0, n, col_tile):
-                cols = jax.lax.dynamic_slice_in_dim(jmat, c0, col_tile, 0)
-                t = tile_ani(rows, cols, sketch_size, k)
-                acc += float(t[0, 0])  # force materialization
-        return acc
-
-    run()  # warmup + compile
+    sharded_pair_count(mat, k=k, min_ani=min_ani, mesh=mesh,
+                       col_tile=col_tile)  # warmup + compile
     t0 = time.perf_counter()
-    run()
-    dt = time.perf_counter() - t0
+    for _ in range(repeats):
+        count = sharded_pair_count(mat, k=k, min_ani=min_ani, mesh=mesh,
+                                   col_tile=col_tile)
+    dt = (time.perf_counter() - t0) / repeats
+    assert count >= 0
     return (n * n) / dt
 
 
-def bench_host_numpy(mat, k, sketch_size, n_pairs=512):
+def bench_host_numpy(mat, k, sketch_size, n_pairs=256):
     """Single-thread host merged-bottom-k Jaccard as the CPU baseline."""
     from galah_tpu.ops.minhash_np import MinHashSketch, mash_ani
 
@@ -69,7 +64,7 @@ def main():
     n = 2048
     mat = _sketches(n, sketch_size, seed=0)
 
-    device_pps = bench_device(mat, k, sketch_size)
+    device_pps = bench_device(mat, k)
     host_pps = bench_host_numpy(mat, k, sketch_size)
 
     print(json.dumps({

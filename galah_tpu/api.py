@@ -1,0 +1,264 @@
+"""Embeddable library API: build and run the clusterer from another tool.
+
+The reference deliberately exports its orchestration layer so CoverM can
+embed Galah as a library — `GalahClusterer`, `generate_galah_clusterer`,
+`add_cluster_subcommand`, and a `GalahClustererCommandDefinition` whose
+fields parameterize the *flag names* so the embedding tool can rename
+them (reference: src/cluster_argument_parsing.rs:84-124, :897-1158,
+:1265-1375). This module is the equivalent surface:
+
+    import argparse
+    from galah_tpu.api import (ClustererCommandDefinition,
+                               add_cluster_arguments,
+                               generate_galah_clusterer)
+
+    defn = ClustererCommandDefinition(ani="dereplication-ani")
+    parser = argparse.ArgumentParser()
+    add_cluster_arguments(parser, defn)     # embeds the renamed flags
+    args = parser.parse_args()
+    clusterer = generate_galah_clusterer(genome_paths, vars(args), defn)
+    clusters = clusterer.cluster()          # indices into .genome_paths
+
+The CLI (cli.py) is a thin consumer of the same functions with the
+default (un-renamed) definition.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from galah_tpu.config import (
+    CLUSTER_METHODS,
+    Defaults,
+    PRECLUSTER_METHODS,
+    QUALITY_FORMULAS,
+    parse_percentage,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClustererCommandDefinition:
+    """Flag names as data, so an embedding tool can rename them.
+
+    Each field is the long-option name (without leading dashes) used for
+    that parameter; defaults match the standalone CLI (reference analog:
+    GalahClustererCommandDefinition, cluster_argument_parsing.rs:90-124).
+    """
+
+    ani: str = "ani"
+    precluster_ani: str = "precluster-ani"
+    min_aligned_fraction: str = "min-aligned-fraction"
+    fragment_length: str = "fragment-length"
+    precluster_method: str = "precluster-method"
+    cluster_method: str = "cluster-method"
+    quality_formula: str = "quality-formula"
+    checkm_tab_table: str = "checkm-tab-table"
+    checkm2_quality_report: str = "checkm2-quality-report"
+    genome_info: str = "genome-info"
+    min_completeness: str = "min-completeness"
+    max_contamination: str = "max-contamination"
+    threads: str = "threads"
+
+    def dest(self, flag_name: str) -> str:
+        return flag_name.replace("-", "_")
+
+
+def add_cluster_arguments(
+    parser: argparse.ArgumentParser,
+    definition: ClustererCommandDefinition = ClustererCommandDefinition(),
+) -> None:
+    """Add the clustering/quality flags under the definition's names."""
+    d = definition
+    parser.add_argument(f"--{d.ani}", type=float, default=Defaults.ANI,
+                        help="Average nucleotide identity threshold for "
+                             "clustering (default: 95)")
+    parser.add_argument(f"--{d.precluster_ani}", type=float,
+                        default=Defaults.PRETHRESHOLD_ANI,
+                        help="Require at least this sketch-derived ANI "
+                             "for preclustering (default: 90)")
+    parser.add_argument(f"--{d.min_aligned_fraction}", type=float,
+                        default=Defaults.ALIGNED_FRACTION * 100,
+                        help="Min aligned fraction of two genomes for "
+                             "clustering (default: 15)")
+    parser.add_argument(f"--{d.fragment_length}", type=int,
+                        default=Defaults.FRAGMENT_LENGTH,
+                        help="Length of fragment used in fastANI-style "
+                             "calculation (default: 3000)")
+    parser.add_argument(f"--{d.precluster_method}",
+                        default=Defaults.PRECLUSTER_METHOD,
+                        choices=PRECLUSTER_METHODS,
+                        help="Method of calculating rough ANI for "
+                             "dereplication (default: skani)")
+    parser.add_argument(f"--{d.cluster_method}",
+                        default=Defaults.CLUSTER_METHOD,
+                        choices=CLUSTER_METHODS,
+                        help="Method of calculating exact ANI for "
+                             "dereplication (default: skani)")
+    parser.add_argument(f"--{d.checkm_tab_table}",
+                        help="Output of `checkm qa .. --tab_table`")
+    parser.add_argument(f"--{d.checkm2_quality_report}",
+                        help="CheckM2 quality_report.tsv output")
+    parser.add_argument(f"--{d.genome_info}",
+                        help="dRep-style genome info CSV "
+                             "(genome,completeness,contamination)")
+    parser.add_argument(f"--{d.min_completeness}", type=float,
+                        help="Ignore genomes with less completeness than "
+                             "this percentage")
+    parser.add_argument(f"--{d.max_contamination}", type=float,
+                        help="Ignore genomes with more contamination than "
+                             "this percentage")
+    parser.add_argument(f"--{d.quality_formula}",
+                        default=Defaults.QUALITY_FORMULA,
+                        choices=QUALITY_FORMULAS,
+                        help="Quality formula for ranking genomes "
+                             "(default: Parks2020_reduced)")
+    parser.add_argument(f"--{d.threads}", "-t", type=int, default=1,
+                        help="Host threads for FASTA stats/IO fan-out; "
+                             "device parallelism is managed by the mesh")
+
+
+@dataclasses.dataclass
+class GalahClusterer:
+    """A ready-to-run clustering job over quality-ordered genome paths.
+
+    `genome_paths` is the post-filter, quality-ordered list; `cluster()`
+    returns clusters of indices into it, representative first
+    (reference analog: GalahClusterer, cluster_argument_parsing.rs:84-88
+    and its .cluster() at :1185).
+    """
+
+    genome_paths: List[str]
+    preclusterer: object
+    clusterer: object
+    checkpoint: Optional[object] = None
+
+    def cluster(self) -> List[List[int]]:
+        from galah_tpu.cluster import cluster as run
+
+        return run(self.genome_paths, self.preclusterer, self.clusterer,
+                   checkpoint=self.checkpoint)
+
+
+def _get(values: Dict, definition: ClustererCommandDefinition,
+         flag_name: str):
+    return values.get(definition.dest(flag_name))
+
+
+def generate_galah_clusterer(
+    genome_paths: Sequence[str],
+    values: Dict,
+    definition: ClustererCommandDefinition = ClustererCommandDefinition(),
+    cache=None,
+) -> GalahClusterer:
+    """Quality-filter + order genomes and construct the backends.
+
+    `values` is a vars(args)-style mapping keyed by the definition's
+    dest names (reference analog: generate_galah_clusterer,
+    cluster_argument_parsing.rs:897-1158). Raises ValueError on
+    conflicting quality inputs, like the reference's factory.
+    """
+    from galah_tpu import quality as quality_mod
+    from galah_tpu.backends import (
+        FastANIEquivalentClusterer,
+        HLLPreclusterer,
+        MinHashPreclusterer,
+        ProfileStore,
+        SkaniEquivalentClusterer,
+        SkaniPreclusterer,
+    )
+    from galah_tpu.io import diskcache
+
+    d = definition
+    cache = cache or diskcache.get_cache()
+
+    ani = parse_percentage(_get(values, d, d.ani), f"--{d.ani}")
+    precluster_ani = parse_percentage(
+        _get(values, d, d.precluster_ani), f"--{d.precluster_ani}")
+    min_af = parse_percentage(
+        _get(values, d, d.min_aligned_fraction),
+        f"--{d.min_aligned_fraction}")
+    fraglen = int(_get(values, d, d.fragment_length)
+                  or Defaults.FRAGMENT_LENGTH)
+    pre_method = _get(values, d, d.precluster_method)
+    cl_method = _get(values, d, d.cluster_method)
+    threads = int(_get(values, d, d.threads) or 1)
+
+    # Quality filter + ordering
+    quality_inputs = [
+        ("checkm_tab_table", _get(values, d, d.checkm_tab_table)),
+        ("checkm2_quality_report",
+         _get(values, d, d.checkm2_quality_report)),
+        ("genome_info", _get(values, d, d.genome_info)),
+    ]
+    given = [(k, v) for k, v in quality_inputs if v]
+    if len(given) > 1:
+        raise ValueError(
+            "Specify at most one of --checkm-tab-table, "
+            "--checkm2-quality-report and --genome-info")
+    genome_paths = list(genome_paths)
+    if not given:
+        logger.warning(
+            "Since CheckM input is missing, genomes are not being ordered "
+            "by quality. Instead the order of their input is being used")
+    else:
+        kind, path = given[0]
+        formula = _get(values, d, d.quality_formula) \
+            or Defaults.QUALITY_FORMULA
+        if kind == "checkm_tab_table":
+            logger.info("Reading CheckM tab table ..")
+            table = quality_mod.read_checkm1_tab_table(path)
+        elif kind == "checkm2_quality_report":
+            logger.info("Reading CheckM2 Quality report ..")
+            table = quality_mod.read_checkm2_quality_report(path)
+        else:
+            if formula == "dRep":
+                raise ValueError(
+                    "The dRep quality formula cannot be used with "
+                    "--genome-info")
+            table = quality_mod.read_genome_info_file(path)
+        min_comp = _get(values, d, d.min_completeness)
+        max_cont = _get(values, d, d.max_contamination)
+        genome_paths = quality_mod.filter_and_order_genomes(
+            genome_paths, table, formula=formula,
+            min_completeness=(parse_percentage(
+                min_comp, f"--{d.min_completeness}")
+                if min_comp is not None else None),
+            max_contamination=(parse_percentage(
+                max_cont, f"--{d.max_contamination}")
+                if max_cont is not None else None),
+            threads=threads,
+        )
+
+    # skani+skani: precluster at the final threshold (reference:
+    # src/cluster_argument_parsing.rs:983-1030)
+    if pre_method == "skani" and cl_method == "skani":
+        precluster_ani = ani
+
+    store = ProfileStore(fraglen=fraglen, cache=cache)
+    if pre_method == "finch":
+        pre = MinHashPreclusterer(min_ani=precluster_ani, cache=cache)
+    elif pre_method == "skani":
+        pre = SkaniPreclusterer(threshold=precluster_ani,
+                                min_aligned_fraction=min_af, store=store)
+    elif pre_method == "dashing":
+        pre = HLLPreclusterer(min_ani=precluster_ani, cache=cache)
+    else:
+        raise ValueError(f"unknown precluster method {pre_method!r}")
+
+    if cl_method == "fastani":
+        cl = FastANIEquivalentClusterer(
+            threshold=ani, min_aligned_fraction=min_af, fraglen=fraglen,
+            store=store)
+    elif cl_method == "skani":
+        cl = SkaniEquivalentClusterer(
+            threshold=ani, min_aligned_fraction=min_af, store=store)
+    else:
+        raise ValueError(f"unknown cluster method {cl_method!r}")
+
+    return GalahClusterer(genome_paths=genome_paths, preclusterer=pre,
+                          clusterer=cl)

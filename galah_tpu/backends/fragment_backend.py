@@ -35,11 +35,13 @@ import numpy as np
 from galah_tpu.backends.base import ClusterBackend, PreclusterBackend
 from galah_tpu.cluster.cache import PairDistanceCache
 from galah_tpu.config import Defaults
+from galah_tpu.io import diskcache
 from galah_tpu.io.fasta import read_genome
 from galah_tpu.ops import fragment_ani
 from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.fragment_ani import GenomeProfile
 from galah_tpu.ops.pairwise import tile_intersect_counts
+from galah_tpu.utils import timing
 
 logger = logging.getLogger(__name__)
 
@@ -47,24 +49,46 @@ ANI_KMER = 15
 
 
 class ProfileStore:
-    """LRU cache: genome path -> GenomeProfile (profile once, reuse)."""
+    """LRU cache: genome path -> GenomeProfile (profile once, reuse).
+
+    With an on-disk cache (io/diskcache.py), the expensive profile
+    arrays (positional hashes, distinct-set, markers) also persist
+    across runs keyed by file identity + (k, fraglen).
+    """
 
     def __init__(self, k: int = ANI_KMER,
                  fraglen: int = Defaults.FRAGMENT_LENGTH,
-                 maxsize: int = 128) -> None:
+                 maxsize: int = 128,
+                 cache: Optional[diskcache.CacheDir] = None) -> None:
         self.k = k
         self.fraglen = fraglen
         self.maxsize = maxsize
+        self.disk = cache or diskcache.get_cache()
         self._cache: "collections.OrderedDict[str, GenomeProfile]" = (
             collections.OrderedDict())
+
+    def _params(self) -> dict:
+        return {"k": self.k, "fraglen": self.fraglen}
 
     def get(self, path: str) -> GenomeProfile:
         prof = self._cache.get(path)
         if prof is not None:
             self._cache.move_to_end(path)
             return prof
-        prof = fragment_ani.build_profile(
-            read_genome(path), k=self.k, fraglen=self.fraglen)
+        entry = self.disk.load(path, "profile", self._params())
+        if entry is not None:
+            prof = GenomeProfile(
+                path=path, k=self.k, fraglen=self.fraglen,
+                flat_hashes=entry["flat_hashes"],
+                ref_set=entry["ref_set"], markers=entry["markers"])
+        else:
+            prof = fragment_ani.build_profile(
+                read_genome(path), k=self.k, fraglen=self.fraglen)
+            self.disk.store(path, "profile", self._params(), {
+                "flat_hashes": prof.flat_hashes,
+                "ref_set": prof.ref_set,
+                "markers": prof.markers,
+            })
         self._cache[path] = prof
         if len(self._cache) > self.maxsize:
             self._cache.popitem(last=False)
@@ -90,9 +114,12 @@ class _FragmentANIMixin:
         self, pairs: Sequence[tuple[str, str]]
     ) -> List[Optional[float]]:
         """ANI for every path pair via coalesced device dispatches."""
-        profs = [(self.store.get(a), self.store.get(b)) for a, b in pairs]
-        results = fragment_ani.bidirectional_ani_batch(
-            profs, min_aligned_frac=self.min_aligned_fraction)
+        with timing.stage("profile-genomes"):
+            profs = [(self.store.get(a), self.store.get(b))
+                     for a, b in pairs]
+        with timing.stage("fragment-ani"):
+            results = fragment_ani.bidirectional_ani_batch(
+                profs, min_aligned_frac=self.min_aligned_fraction)
         return [ani for ani, _, _ in results]
 
 
@@ -162,7 +189,8 @@ class SkaniPreclusterer(PreclusterBackend):
         n = len(genome_paths)
         logger.info("Profiling %d genomes for skani-style preclustering ..",
                     n)
-        profiles = [self.store.get(p) for p in genome_paths]
+        with timing.stage("profile-genomes"):
+            profiles = [self.store.get(p) for p in genome_paths]
 
         # Marker matrix: pad each genome's marker sketch to a common width.
         m = max(max((p.markers.shape[0] for p in profiles), default=1), 1)
@@ -182,21 +210,22 @@ class SkaniPreclusterer(PreclusterBackend):
         c_floor = self.SCREEN_IDENTITY ** self.store.k
         jmat = np.asarray(mat)
         pairs: List[Tuple[int, int]] = []
-        for r0 in range(0, n, tile):
-            rows = jmat[r0: r0 + tile]
-            for c0 in range(r0, n, tile):
-                inter = np.asarray(tile_intersect_counts(
-                    rows, jmat[c0: c0 + tile])).astype(np.float64)
-                denom = np.minimum.outer(
-                    counts[r0: r0 + tile], counts[c0: c0 + tile]
-                ).astype(np.float64)
-                with np.errstate(divide="ignore", invalid="ignore"):
-                    containment = np.where(denom > 0, inter / denom, 0.0)
-                ri, ci = np.nonzero(containment >= c_floor)
-                for a, b in zip(ri.tolist(), ci.tolist()):
-                    gi, gj = r0 + a, c0 + b
-                    if gi < gj < n:
-                        pairs.append((gi, gj))
+        with timing.stage("marker-screen"):
+            for r0 in range(0, n, tile):
+                rows = jmat[r0: r0 + tile]
+                for c0 in range(r0, n, tile):
+                    inter = np.asarray(tile_intersect_counts(
+                        rows, jmat[c0: c0 + tile])).astype(np.float64)
+                    denom = np.minimum.outer(
+                        counts[r0: r0 + tile], counts[c0: c0 + tile]
+                    ).astype(np.float64)
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        containment = np.where(denom > 0, inter / denom, 0.0)
+                    ri, ci = np.nonzero(containment >= c_floor)
+                    for a, b in zip(ri.tolist(), ci.tolist()):
+                        gi, gj = r0 + a, c0 + b
+                        if gi < gj < n:
+                            pairs.append((gi, gj))
         ii = [p[0] for p in pairs]
         jj = [p[1] for p in pairs]
         logger.info("%d pairs passed screening; computing exact ANI ..",

@@ -14,7 +14,9 @@ from typing import Sequence
 from galah_tpu.backends.base import PreclusterBackend
 from galah_tpu.cluster.cache import PairDistanceCache
 from galah_tpu.config import Defaults
+from galah_tpu.io import diskcache
 from galah_tpu.ops import hll
+from galah_tpu.utils import timing
 
 logger = logging.getLogger(__name__)
 
@@ -24,11 +26,13 @@ class HLLPreclusterer(PreclusterBackend):
 
     def __init__(self, min_ani: float, p: int = hll.DEFAULT_P,
                  k: int = Defaults.MINHASH_KMER,
-                 seed: int = Defaults.MINHASH_SEED) -> None:
+                 seed: int = Defaults.MINHASH_SEED,
+                 cache: "diskcache.CacheDir | None" = None) -> None:
         self.min_ani = float(min_ani)
         self.p = int(p)
         self.k = int(k)
         self.seed = int(seed)
+        self.cache = cache or diskcache.get_cache()
 
     def method_name(self) -> str:
         return "dashing"
@@ -40,14 +44,22 @@ class HLLPreclusterer(PreclusterBackend):
 
         n = len(genome_paths)
         logger.info("Sketching HLL registers of %d genomes on device ..", n)
+        params = {"p": self.p, "k": self.k, "seed": self.seed}
         regs = np.zeros((n, 1 << self.p), dtype=np.uint8)
-        for i, path in enumerate(genome_paths):
-            regs[i] = hll.hll_sketch_genome(
-                read_genome(path), p=self.p, k=self.k, seed=self.seed)
+        with timing.stage("sketch-hll"):
+            for i, path in enumerate(genome_paths):
+                entry = self.cache.load(path, "hll", params)
+                if entry is not None:
+                    regs[i] = entry["regs"]
+                    continue
+                regs[i] = hll.hll_sketch_genome(
+                    read_genome(path), p=self.p, k=self.k, seed=self.seed)
+                self.cache.store(path, "hll", params, {"regs": regs[i]})
 
         logger.info("Computing tiled all-pairs HLL ANI ..")
-        pairs = hll.hll_threshold_pairs(regs, k=self.k,
-                                        min_ani=self.min_ani)
+        with timing.stage("pairwise-hll"):
+            pairs = hll.hll_threshold_pairs(regs, k=self.k,
+                                            min_ani=self.min_ani)
         cache = PairDistanceCache()
         for (i, j), ani in pairs.items():
             cache.insert((i, j), ani)

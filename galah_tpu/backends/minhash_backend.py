@@ -15,30 +15,51 @@ from typing import Dict, Optional, Sequence
 from galah_tpu.backends.base import PreclusterBackend
 from galah_tpu.cluster.cache import PairDistanceCache
 from galah_tpu.config import Defaults
+from galah_tpu.io import diskcache
+from galah_tpu.io.diskcache import CacheDir
 from galah_tpu.io.fasta import read_genome
 from galah_tpu.ops.minhash import sketch_genome_device, sketch_matrix
 from galah_tpu.ops.minhash_np import MinHashSketch
 from galah_tpu.ops.pairwise import threshold_pairs
+from galah_tpu.utils import timing
 
 logger = logging.getLogger(__name__)
 
 
 class SketchStore:
-    """Per-run cache: genome path -> MinHash sketch (sketch once, reuse)."""
+    """Per-run cache: genome path -> MinHash sketch (sketch once, reuse).
 
-    def __init__(self, sketch_size: int, k: int, seed: int = 0) -> None:
+    With a `cache` (io/diskcache.py), sketches also persist across runs,
+    keyed by file identity + (sketch_size, k, seed).
+    """
+
+    def __init__(self, sketch_size: int, k: int, seed: int = 0,
+                 cache: Optional["CacheDir"] = None) -> None:
         self.sketch_size = sketch_size
         self.k = k
         self.seed = seed
+        self.cache = cache or diskcache.get_cache()
         self._sketches: Dict[str, MinHashSketch] = {}
+
+    def _params(self) -> dict:
+        return {"sketch_size": self.sketch_size, "k": self.k,
+                "seed": self.seed}
 
     def get(self, path: str) -> MinHashSketch:
         s = self._sketches.get(path)
-        if s is None:
+        if s is not None:
+            return s
+        entry = self.cache.load(path, "minhash", self._params())
+        if entry is not None:
+            s = MinHashSketch(hashes=entry["hashes"],
+                              sketch_size=self.sketch_size, kmer=self.k)
+        else:
             s = sketch_genome_device(
                 read_genome(path), sketch_size=self.sketch_size,
                 k=self.k, seed=self.seed)
-            self._sketches[path] = s
+            self.cache.store(path, "minhash", self._params(),
+                             {"hashes": s.hashes})
+        self._sketches[path] = s
         return s
 
 
@@ -49,11 +70,12 @@ class MinHashPreclusterer(PreclusterBackend):
         sketch_size: int = Defaults.MINHASH_SKETCH_SIZE,
         k: int = Defaults.MINHASH_KMER,
         store: Optional[SketchStore] = None,
+        cache: Optional[CacheDir] = None,
     ) -> None:
         self.min_ani = float(min_ani)
         self.sketch_size = sketch_size
         self.k = k
-        self.store = store or SketchStore(sketch_size, k)
+        self.store = store or SketchStore(sketch_size, k, cache=cache)
 
     def method_name(self) -> str:
         return "finch"
@@ -62,12 +84,14 @@ class MinHashPreclusterer(PreclusterBackend):
         logger.info(
             "Sketching MinHash representations of %d genomes on device ..",
             len(genome_paths))
-        sketches = [self.store.get(p) for p in genome_paths]
-        mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
+        with timing.stage("sketch-minhash"):
+            sketches = [self.store.get(p) for p in genome_paths]
+            mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
         logger.info("Computing tiled all-pairs Mash ANI ..")
-        pairs = threshold_pairs(
-            mat, k=self.k, min_ani=self.min_ani,
-            sketch_size=self.sketch_size)
+        with timing.stage("pairwise-minhash"):
+            pairs = threshold_pairs(
+                mat, k=self.k, min_ani=self.min_ani,
+                sketch_size=self.sketch_size)
         cache = PairDistanceCache()
         for (i, j), ani in pairs.items():
             cache.insert((i, j), ani)

@@ -14,13 +14,9 @@ import logging
 import sys
 
 import galah_tpu
-from galah_tpu.config import (
-    CLUSTER_METHODS,
-    Defaults,
-    PRECLUSTER_METHODS,
-    QUALITY_FORMULAS,
-    parse_percentage,
-)
+from galah_tpu.api import add_cluster_arguments, generate_galah_clusterer
+from galah_tpu.config import Defaults, parse_percentage
+from galah_tpu.utils import timing
 from galah_tpu.utils.logging import set_log_level
 
 logger = logging.getLogger("galah_tpu")
@@ -31,6 +27,8 @@ def _add_verbosity(p: argparse.ArgumentParser) -> None:
                    help="Print extra debugging information")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="Unless there is an error, do not print log messages")
+    p.add_argument("--full-help", action="store_true",
+                   help="Display an extended man-style help page and exit")
 
 
 def _add_genome_inputs(p: argparse.ArgumentParser) -> None:
@@ -57,52 +55,27 @@ def build_parser() -> argparse.ArgumentParser:
     c = sub.add_parser(
         "cluster",
         help="Cluster genomes by ANI, choosing quality-ranked "
-             "representatives")
+             "representatives",
+        description="Cluster genomes by average nucleotide identity "
+                    "(ANI), choosing one quality-ranked representative "
+                    "genome per cluster, on TPU")
     _add_verbosity(c)
     _add_genome_inputs(c)
-    c.add_argument("--ani", type=float, default=Defaults.ANI,
-                   help="Average nucleotide identity threshold for "
-                        "clustering (default: 95)")
-    c.add_argument("--precluster-ani", type=float,
-                   default=Defaults.PRETHRESHOLD_ANI,
-                   help="Require at least this sketch-derived ANI for "
-                        "preclustering (default: 90)")
-    c.add_argument("--min-aligned-fraction", type=float,
-                   default=Defaults.ALIGNED_FRACTION * 100,
-                   help="Min aligned fraction of two genomes for "
-                        "clustering (default: 15)")
-    c.add_argument("--fragment-length", type=int,
-                   default=Defaults.FRAGMENT_LENGTH,
-                   help="Length of fragment used in fastANI-style "
-                        "calculation (default: 3000)")
-    c.add_argument("--precluster-method", default=Defaults.PRECLUSTER_METHOD,
-                   choices=PRECLUSTER_METHODS,
-                   help="Method of calculating rough ANI for "
-                        "dereplication (default: skani)")
-    c.add_argument("--cluster-method", default=Defaults.CLUSTER_METHOD,
-                   choices=CLUSTER_METHODS,
-                   help="Method of calculating exact ANI for "
-                        "dereplication (default: skani)")
-    c.add_argument("--checkm-tab-table",
-                   help="Output of `checkm qa .. --tab_table`")
-    c.add_argument("--checkm2-quality-report",
-                   help="CheckM2 quality_report.tsv output")
-    c.add_argument("--genome-info",
-                   help="dRep-style genome info CSV "
-                        "(genome,completeness,contamination)")
-    c.add_argument("--min-completeness", type=float,
-                   help="Ignore genomes with less completeness than this "
-                        "percentage")
-    c.add_argument("--max-contamination", type=float,
-                   help="Ignore genomes with more contamination than this "
-                        "percentage")
-    c.add_argument("--quality-formula", default=Defaults.QUALITY_FORMULA,
-                   choices=QUALITY_FORMULAS,
-                   help="Quality formula for ranking genomes "
-                        "(default: Parks2020_reduced)")
-    c.add_argument("--threads", "-t", type=int, default=1,
-                   help="Host threads for FASTA stats/IO fan-out; device "
-                        "parallelism is managed by the mesh")
+    # Shared clustering/quality flags come from the embeddable API
+    # factory (api.py) so the CLI and embedding tools stay in lockstep.
+    add_cluster_arguments(c)
+    c.add_argument("--sketch-cache",
+                   help="Directory for the persistent sketch/profile "
+                        "cache (also via GALAH_TPU_CACHE); sketches are "
+                        "reused across runs when genome files are "
+                        "unchanged")
+    c.add_argument("--profile-trace-dir",
+                   help="Capture an XLA profiler trace of the run into "
+                        "this directory (TensorBoard-loadable)")
+    c.add_argument("--checkpoint-dir",
+                   help="Persist the distance pass and finished "
+                        "preclusters here; an interrupted run resumes "
+                        "from the last completed precluster")
     c.add_argument("--output-cluster-definition",
                    help="Output file of rep<TAB>member lines")
     c.add_argument("--output-representative-fasta-directory",
@@ -112,10 +85,14 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--output-representative-list",
                    help="Output file with one representative path per line")
 
-    v = sub.add_parser("cluster-validate", help="Verify clustering results")
+    v = sub.add_parser(
+        "cluster-validate", help="Verify clustering results",
+        description="Re-check a cluster output file: every member must "
+                    "reach the ANI threshold to its representative, and "
+                    "no two representatives may reach it to each other")
     _add_verbosity(v)
-    v.add_argument("--cluster-file", required=True,
-                   help="Output of 'cluster' subcommand")
+    v.add_argument("--cluster-file",
+                   help="Output of 'cluster' subcommand (required)")
     v.add_argument("--ani", type=float, default=99.0,
                    help="ANI to validate against (default: 99)")
     v.add_argument("--min-aligned-fraction", type=float, default=50.0,
@@ -126,70 +103,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Length of fragment used in fastANI-style "
                         "calculation (default: 3000)")
     v.add_argument("--threads", "-t", type=int, default=1)
+    parser._subcommand_parsers = {"cluster": c, "cluster-validate": v}
     return parser
 
 
-def _build_backends(args, store=None):
-    """Backend factory (reference: generate_galah_clusterer,
-    src/cluster_argument_parsing.rs:897-1158)."""
-    from galah_tpu.backends import (
-        FastANIEquivalentClusterer,
-        HLLPreclusterer,
-        MinHashPreclusterer,
-        ProfileStore,
-        SkaniEquivalentClusterer,
-        SkaniPreclusterer,
-    )
-
-    ani = parse_percentage(args.ani, "--ani")
-    precluster_ani = parse_percentage(args.precluster_ani, "--precluster-ani")
-    min_af = parse_percentage(args.min_aligned_fraction,
-                              "--min-aligned-fraction")
-
-    # skani+skani special case: precluster at the final ANI threshold
-    # (unconditionally) so reused values reflect the real cutoff
-    # (reference: src/cluster_argument_parsing.rs:983-1030, exercised by
-    # the reference's test_skani_skani_clusterer with --precluster-ani 99
-    # --ani 95 clustering everything at 95).
-    if args.precluster_method == "skani" and args.cluster_method == "skani":
-        if precluster_ani != ani:
-            logger.info(
-                "Preclustering at the final ANI threshold %.4f since "
-                "precluster and cluster methods are both skani", ani)
-        precluster_ani = ani
-
-    store = store or ProfileStore(fraglen=args.fragment_length)
-    if args.precluster_method == "finch":
-        pre = MinHashPreclusterer(min_ani=precluster_ani)
-    elif args.precluster_method == "skani":
-        pre = SkaniPreclusterer(
-            threshold=precluster_ani, min_aligned_fraction=min_af,
-            store=store)
-    elif args.precluster_method == "dashing":
-        # HyperLogLog subprocess backend in the reference; here a device
-        # HLL kernel (reference: src/dashing.rs:11-100).
-        pre = HLLPreclusterer(min_ani=precluster_ani)
-    else:
-        raise ValueError(args.precluster_method)
-
-    if args.cluster_method == "fastani":
-        cl = FastANIEquivalentClusterer(
-            threshold=ani, min_aligned_fraction=min_af,
-            fraglen=args.fragment_length, store=store)
-    elif args.cluster_method == "skani":
-        cl = SkaniEquivalentClusterer(
-            threshold=ani, min_aligned_fraction=min_af, store=store)
-    else:
-        raise ValueError(args.cluster_method)
-    return pre, cl
-
-
 def run_cluster(args) -> int:
-    from galah_tpu import quality as quality_mod
-    from galah_tpu.cluster import cluster as run_clustering
     from galah_tpu.genome_inputs import parse_genome_inputs
+    from galah_tpu.io import diskcache
     from galah_tpu.outputs import setup_outputs, write_outputs
 
+    timing.reset()
     genomes = parse_genome_inputs(
         genome_fasta_files=args.genome_fasta_files,
         genome_fasta_list=args.genome_fasta_list,
@@ -197,48 +120,15 @@ def run_cluster(args) -> int:
         genome_fasta_extension=args.genome_fasta_extension,
     )
 
-    # Quality filter + ordering (reference: filter_genomes_through_checkm,
-    # src/cluster_argument_parsing.rs:576-832)
-    n_quality_inputs = sum(
-        1 for x in (args.checkm_tab_table, args.checkm2_quality_report,
-                    args.genome_info) if x)
-    if n_quality_inputs > 1:
-        logger.error("Specify at most one of --checkm-tab-table, "
-                     "--checkm2-quality-report and --genome-info")
-        return 1
-    if n_quality_inputs == 0:
-        logger.warning(
-            "Since CheckM input is missing, genomes are not being ordered "
-            "by quality. Instead the order of their input is being used")
-    else:
-        if args.checkm_tab_table:
-            logger.info("Reading CheckM tab table ..")
-            table = quality_mod.read_checkm1_tab_table(args.checkm_tab_table)
-        elif args.checkm2_quality_report:
-            logger.info("Reading CheckM2 Quality report ..")
-            table = quality_mod.read_checkm2_quality_report(
-                args.checkm2_quality_report)
-        else:
-            if args.quality_formula == "dRep":
-                logger.error(
-                    "The dRep quality formula cannot be used with "
-                    "--genome-info")
-                return 1
-            logger.info("Reading genome info file %s", args.genome_info)
-            table = quality_mod.read_genome_info_file(args.genome_info)
-        genomes = quality_mod.filter_and_order_genomes(
-            genomes, table,
-            formula=args.quality_formula,
-            min_completeness=(parse_percentage(
-                args.min_completeness, "--min-completeness")
-                if args.min_completeness is not None else None),
-            max_contamination=(parse_percentage(
-                args.max_contamination, "--max-contamination")
-                if args.max_contamination is not None else None),
-            threads=args.threads,
-        )
+    cache = diskcache.get_cache(getattr(args, "sketch_cache", None))
+    if cache.enabled:
+        logger.info("Using persistent sketch cache at %s", cache.path)
 
-    pre, cl = _build_backends(args)
+    # Quality filtering/ordering + backend construction live in the
+    # embeddable factory (api.py, reference analog:
+    # generate_galah_clusterer, src/cluster_argument_parsing.rs:897-1158)
+    clusterer = generate_galah_clusterer(genomes, vars(args), cache=cache)
+    genomes = clusterer.genome_paths
 
     # Open output handles before compute (fail fast)
     handles = setup_outputs(
@@ -250,12 +140,33 @@ def run_cluster(args) -> int:
         representative_list=args.output_representative_list,
     )
 
+    ckpt = None
+    if getattr(args, "checkpoint_dir", None):
+        from galah_tpu.cluster.checkpoint import (
+            ClusterCheckpoint,
+            run_fingerprint,
+        )
+
+        ckpt = ClusterCheckpoint(
+            args.checkpoint_dir,
+            run_fingerprint(
+                genomes, args.precluster_method, args.cluster_method,
+                parse_percentage(args.ani, "--ani"),
+                parse_percentage(args.precluster_ani, "--precluster-ani"),
+                min_aligned_fraction=parse_percentage(
+                    args.min_aligned_fraction, "--min-aligned-fraction"),
+                fragment_length=args.fragment_length))
+        clusterer.checkpoint = ckpt
+
     logger.info("Clustering %d genomes ..", len(genomes))
-    clusters = run_clustering(genomes, pre, cl)
+    with timing.trace_context(getattr(args, "profile_trace_dir", None)):
+        clusters = clusterer.cluster()
     logger.info("Found %d genome clusters", len(clusters))
 
-    write_outputs(handles, clusters, genomes)
+    with timing.stage("write-outputs"):
+        write_outputs(handles, clusters, genomes)
     logger.info("Finished printing genome clusters")
+    timing.GLOBAL.report(logger)
     return 0
 
 
@@ -263,6 +174,9 @@ def run_cluster_validate(args) -> int:
     from galah_tpu.backends import FastANIEquivalentClusterer, ProfileStore
     from galah_tpu.validate import validate_clusters
 
+    if not args.cluster_file:
+        logger.error("--cluster-file is required")
+        return 1
     ani = parse_percentage(args.ani, "--ani")
     min_af = parse_percentage(args.min_aligned_fraction,
                               "--min-aligned-fraction")
@@ -280,6 +194,12 @@ def main(argv=None) -> int:
     if args.subcommand is None:
         parser.print_help()
         return 1
+    if getattr(args, "full_help", False):
+        from galah_tpu.manpage import print_full_help
+
+        print_full_help(parser._subcommand_parsers[args.subcommand],
+                        args.subcommand)
+        return 0
     set_log_level(verbose=getattr(args, "verbose", False),
                   quiet=getattr(args, "quiet", False))
     logger.info("galah-tpu version %s", galah_tpu.__version__)

@@ -1,0 +1,162 @@
+"""Checkpoint / resume for long clustering runs.
+
+The reference keeps ALL intermediate state in memory — a crash at hour
+N of a 50k-genome run loses everything (SURVEY.md §5: no
+checkpoint/resume subsystem exists). Here the two expensive phases
+persist incrementally:
+
+  1. the precluster distance pass result (the sparse pair cache) is
+     saved once, right after it completes;
+  2. each precluster's finished clusters append to a log as the greedy
+     phase walks the precluster list (big-first order is deterministic,
+     so the resume point is well-defined).
+
+A checkpoint is bound to a *fingerprint* — genome list (paths in quality
+order), thresholds, methods — so resuming with different inputs starts
+fresh instead of corrupting results. Everything is plain npz/json under
+one directory; delete the directory to force a full re-run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from galah_tpu.cluster.cache import PairDistanceCache
+
+logger = logging.getLogger(__name__)
+
+_FINGERPRINT = "fingerprint.json"
+_DISTANCES = "precluster_distances.npz"
+_CLUSTERS = "clusters.jsonl"
+
+
+def run_fingerprint(genomes: Sequence[str], precluster_method: str,
+                    cluster_method: str, ani: float,
+                    precluster_ani: float,
+                    min_aligned_fraction: float = 0.0,
+                    fragment_length: int = 0) -> str:
+    """Hash of everything that affects clustering results — any change
+    invalidates the checkpoint rather than silently resuming stale
+    state."""
+    ident = json.dumps({
+        "genomes": list(genomes),
+        "precluster_method": precluster_method,
+        "cluster_method": cluster_method,
+        "ani": ani,
+        "precluster_ani": precluster_ani,
+        "min_aligned_fraction": min_aligned_fraction,
+        "fragment_length": fragment_length,
+    }, sort_keys=True)
+    return hashlib.sha256(ident.encode()).hexdigest()
+
+
+class ClusterCheckpoint:
+    """One run's resumable state under `path` (None disables)."""
+
+    def __init__(self, path: Optional[str], fingerprint: str) -> None:
+        self.path = path
+        self.fingerprint = fingerprint
+        if not path:
+            return
+        os.makedirs(path, exist_ok=True)
+        fp_file = os.path.join(path, _FINGERPRINT)
+        if os.path.exists(fp_file):
+            with open(fp_file) as f:
+                existing = json.load(f).get("fingerprint")
+            if existing != fingerprint:
+                logger.warning(
+                    "Checkpoint at %s belongs to a different run "
+                    "configuration; starting fresh", path)
+                for name in (_FINGERPRINT, _DISTANCES, _CLUSTERS):
+                    try:
+                        os.unlink(os.path.join(path, name))
+                    except FileNotFoundError:
+                        pass
+        with open(fp_file, "w") as f:
+            json.dump({"fingerprint": fingerprint}, f)
+
+    @property
+    def enabled(self) -> bool:
+        return self.path is not None
+
+    # -- precluster distance pass ------------------------------------
+
+    def load_distances(self) -> Optional[PairDistanceCache]:
+        if not self.enabled:
+            return None
+        fn = os.path.join(self.path, _DISTANCES)
+        if not os.path.exists(fn):
+            return None
+        with np.load(fn) as z:
+            ii, jj = z["ii"], z["jj"]
+            vals, has_val = z["vals"], z["has_val"]
+        cache = PairDistanceCache()
+        for i, j, v, hv in zip(ii.tolist(), jj.tolist(),
+                               vals.tolist(), has_val.tolist()):
+            cache.insert((i, j), float(v) if hv else None)
+        logger.info("Resumed precluster distances from checkpoint "
+                    "(%d pairs)", len(cache))
+        return cache
+
+    def save_distances(self, cache: PairDistanceCache) -> None:
+        if not self.enabled:
+            return
+        keys = sorted(cache.keys())
+        ii = np.array([k[0] for k in keys], dtype=np.int64)
+        jj = np.array([k[1] for k in keys], dtype=np.int64)
+        has_val = np.array([cache.get(k) is not None for k in keys],
+                           dtype=bool)
+        vals = np.array([cache.get(k) or 0.0 for k in keys],
+                        dtype=np.float64)
+        tmp = os.path.join(self.path, _DISTANCES + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, ii=ii, jj=jj, vals=vals, has_val=has_val)
+        os.replace(tmp, os.path.join(self.path, _DISTANCES))
+        logger.info("Checkpointed precluster distances (%d pairs)",
+                    len(cache))
+
+    # -- greedy phase, per-precluster --------------------------------
+
+    def load_completed(self) -> Dict[int, List[List[int]]]:
+        """{precluster index -> its clusters (global genome ids)}."""
+        out: Dict[int, List[List[int]]] = {}
+        if not self.enabled:
+            return out
+        fn = os.path.join(self.path, _CLUSTERS)
+        if not os.path.exists(fn):
+            return out
+        with open(fn) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # torn tail from a kill mid-write: drop it (that
+                    # precluster just recomputes) rather than failing
+                    # the resume
+                    logger.warning(
+                        "Dropping torn checkpoint record in %s", fn)
+                    continue
+                out[int(rec["precluster"])] = rec["clusters"]
+        if out:
+            logger.info("Resuming: %d preclusters already clustered",
+                        len(out))
+        return out
+
+    def save_precluster(self, index: int,
+                        clusters: List[List[int]]) -> None:
+        if not self.enabled:
+            return
+        with open(os.path.join(self.path, _CLUSTERS), "a") as f:
+            f.write(json.dumps({"precluster": index,
+                                "clusters": clusters}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())

@@ -26,11 +26,15 @@ Semantics preserved:
 from __future__ import annotations
 
 import logging
-from typing import List, Optional, Sequence, Set, Tuple
+from typing import TYPE_CHECKING, List, Optional, Sequence, Set, Tuple
+
+if TYPE_CHECKING:
+    from galah_tpu.cluster.checkpoint import ClusterCheckpoint
 
 from galah_tpu.backends.base import ClusterBackend, PreclusterBackend
 from galah_tpu.cluster.cache import PairDistanceCache, pair_key
 from galah_tpu.cluster.partition import partition_preclusters
+from galah_tpu.utils import timing
 
 logger = logging.getLogger(__name__)
 
@@ -39,6 +43,7 @@ def cluster(
     genomes: Sequence[str],
     preclusterer: PreclusterBackend,
     clusterer: ClusterBackend,
+    checkpoint: Optional["ClusterCheckpoint"] = None,
 ) -> List[List[int]]:
     """Cluster quality-ordered genome paths -> list of index clusters.
 
@@ -46,6 +51,10 @@ def cluster(
     precluster processing order (biggest precluster first) then by
     representative index — deterministic, unlike the reference's
     thread-completion order.
+
+    With a `checkpoint` (cluster/checkpoint.py), the distance pass and
+    each finished precluster persist to disk; an interrupted run resumes
+    from the last completed precluster.
     """
     skip_clusterer = preclusterer.method_name() == clusterer.method_name()
     if skip_clusterer:
@@ -53,26 +62,41 @@ def cluster(
             "Preclustering and clustering methods are the same, "
             "so reusing ANI values")
 
-    pre_cache = preclusterer.distances(genomes)
+    pre_cache = checkpoint.load_distances() if checkpoint else None
+    if pre_cache is None:
+        with timing.stage("precluster-distances"):
+            pre_cache = preclusterer.distances(genomes)
+        if checkpoint:
+            checkpoint.save_distances(pre_cache)
 
     logger.info("Preclustering ..")
-    preclusters = partition_preclusters(len(genomes), pre_cache.keys())
+    with timing.stage("partition"):
+        preclusters = partition_preclusters(len(genomes), pre_cache.keys())
     logger.info("Found %d preclusters. The largest contained %d genomes",
                 len(preclusters), len(preclusters[0]) if preclusters else 0)
+
+    done = checkpoint.load_completed() if checkpoint else {}
 
     logger.info(
         "Finding representative genomes and assigning all genomes ..")
     all_clusters: List[List[int]] = []
-    for members in preclusters:
-        local_cache = pre_cache.transform_ids(members)
-        local_genomes = [genomes[g] for g in members]
-        reps, ani_cache = _find_representatives(
-            clusterer, local_cache, local_genomes, skip_clusterer)
-        local_clusters = _find_memberships(
-            clusterer, reps, local_cache, local_genomes, ani_cache,
-            skip_clusterer)
-        for c in local_clusters:
-            all_clusters.append([members[i] for i in c])
+    with timing.stage("greedy-cluster"):
+        for pc_index, members in enumerate(preclusters):
+            if pc_index in done:
+                all_clusters.extend(done[pc_index])
+                continue
+            local_cache = pre_cache.transform_ids(members)
+            local_genomes = [genomes[g] for g in members]
+            reps, ani_cache = _find_representatives(
+                clusterer, local_cache, local_genomes, skip_clusterer)
+            local_clusters = _find_memberships(
+                clusterer, reps, local_cache, local_genomes, ani_cache,
+                skip_clusterer)
+            global_clusters = [[members[i] for i in c]
+                               for c in local_clusters]
+            all_clusters.extend(global_clusters)
+            if checkpoint:
+                checkpoint.save_precluster(pc_index, global_clusters)
     logger.info("Found %d clusters", len(all_clusters))
     return all_clusters
 

@@ -1,0 +1,123 @@
+"""On-disk sketch / profile cache keyed by genome file identity + params.
+
+The reference has no persistent caching at all — every run re-sketches
+every genome from FASTA (SURVEY.md §5; the skani clusterer even
+re-sketches per *pair*, reference: src/skani.rs:171-172). At the 50k-
+genome scale this framework targets, ingestion + sketching is a large
+fixed cost, so every sketch kind (MinHash vector, HLL registers,
+fragment-ANI profile arrays) can be persisted once and memory-mapped
+back on later runs.
+
+Design:
+  * a cache entry is one ``.npz`` file under the cache directory, named
+    by a SHA-256 of (absolute path, file size, mtime_ns, kind, params) —
+    touching or replacing a FASTA invalidates its entries automatically;
+  * writes go through a temp file + ``os.replace`` so concurrent runs
+    sharing a cache directory never observe torn entries;
+  * the cache is strictly optional: ``CacheDir(None)`` is a no-op store,
+    so call sites keep one code path.
+
+Enabled via ``--sketch-cache DIR`` on the CLI or the
+``GALAH_TPU_CACHE`` environment variable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_ENV_VAR = "GALAH_TPU_CACHE"
+
+
+def default_cache_dir() -> Optional[str]:
+    """Cache directory from the environment, or None (disabled)."""
+    return os.environ.get(_ENV_VAR) or None
+
+
+class CacheDir:
+    """A directory of npz cache entries; ``CacheDir(None)`` disables."""
+
+    def __init__(self, path: Optional[str]) -> None:
+        self.path = path
+        if path:
+            os.makedirs(path, exist_ok=True)
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.path is not None
+
+    def _entry_path(self, genome_path: str, kind: str, params: dict) -> str:
+        st = os.stat(genome_path)
+        ident = json.dumps({
+            "path": os.path.abspath(genome_path),
+            "size": st.st_size,
+            "mtime_ns": st.st_mtime_ns,
+            "kind": kind,
+            "params": {k: params[k] for k in sorted(params)},
+        }, sort_keys=True)
+        digest = hashlib.sha256(ident.encode()).hexdigest()[:32]
+        return os.path.join(self.path, f"{kind}-{digest}.npz")
+
+    def load(self, genome_path: str, kind: str,
+             params: dict) -> Optional[Dict[str, np.ndarray]]:
+        """Arrays for (genome, kind, params), or None on miss/disabled."""
+        if not self.enabled:
+            return None
+        entry = self._entry_path(genome_path, kind, params)
+        try:
+            with np.load(entry) as z:
+                out = {name: z[name] for name in z.files}
+            self.hits += 1
+            return out
+        except FileNotFoundError:
+            self.misses += 1
+            return None
+        except Exception as exc:  # corrupt entry: drop and recompute
+            logger.warning("Dropping unreadable cache entry %s (%s)",
+                           entry, exc)
+            try:
+                os.unlink(entry)
+            except OSError:
+                pass
+            self.misses += 1
+            return None
+
+    def store(self, genome_path: str, kind: str, params: dict,
+              arrays: Dict[str, np.ndarray]) -> None:
+        if not self.enabled:
+            return
+        entry = self._entry_path(genome_path, kind, params)
+        fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, entry)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def stats(self) -> str:
+        return f"{self.hits} hits / {self.misses} misses"
+
+
+_NONE = CacheDir(None)
+
+
+def get_cache(path: Optional[str] = None) -> CacheDir:
+    """CacheDir for `path`, the env-var default, or the disabled cache."""
+    if path is None:
+        path = default_cache_dir()
+    return CacheDir(path) if path else _NONE

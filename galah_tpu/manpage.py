@@ -1,0 +1,171 @@
+"""Extended `--full-help` pages, rendered man-style to the pager.
+
+The reference generates roff man pages from its flag definitions and
+pipes them through `man` for --full-help (reference:
+src/cluster_argument_parsing.rs:1194-1263 and the bird_tool_utils-man
+builder). Here the same content is generated from the argparse parser
+plus section prose, rendered as plain text (no roff/man dependency), and
+paged when stdout is a TTY.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+from typing import List, Tuple
+
+WIDTH = 78
+
+
+def _wrap(text: str, indent: int = 3) -> str:
+    return textwrap.fill(
+        " ".join(text.split()), width=WIDTH,
+        initial_indent=" " * indent, subsequent_indent=" " * indent)
+
+
+def _format_action(action: argparse.Action) -> str:
+    flags = ", ".join(action.option_strings)
+    if action.metavar:
+        flags += f" {action.metavar}"
+    elif action.nargs != 0 and not isinstance(
+            action, (argparse._StoreTrueAction, argparse._VersionAction)):
+        flags += f" <{action.dest.upper()}>"
+    lines = [f"  {flags}"]
+    if action.help:
+        help_text = action.help
+        if action.choices:
+            help_text += f" [choices: {', '.join(map(str, action.choices))}]"
+        lines.append(_wrap(help_text, indent=6))
+    return "\n".join(lines)
+
+
+# Flags grouped into man-page sections; every flag not named here lands
+# in OTHER GENERAL OPTIONS so new flags can never silently vanish from
+# the page.
+_SECTIONS: List[Tuple[str, str, List[str]]] = [
+    ("GENOME INPUT",
+     "Genomes may be given as explicit FASTA paths, a directory of "
+     "FASTA files, or a text file listing one path per line. All input "
+     "modes can be combined.",
+     ["--genome-fasta-files", "--genome-fasta-list",
+      "--genome-fasta-directory", "--genome-fasta-extension"]),
+    ("CLUSTERING PARAMETERS",
+     "Dereplication proceeds in two stages: a cheap sketch-based "
+     "precluster pass over all genome pairs, then an exact ANI pass "
+     "restricted to pairs that survived preclustering. Thresholds "
+     "accept percentages (1-100) or fractions (0-1).",
+     ["--ani", "--precluster-ani", "--min-aligned-fraction",
+      "--fragment-length", "--precluster-method", "--cluster-method"]),
+    ("QUALITY FILTERING AND RANKING",
+     "When a quality table is provided, genomes are filtered by "
+     "completeness/contamination and ranked by the quality formula; "
+     "higher-ranked genomes are preferred as cluster representatives. "
+     "Without one, input order is used (a warning is printed).",
+     ["--checkm-tab-table", "--checkm2-quality-report", "--genome-info",
+      "--min-completeness", "--max-contamination", "--quality-formula"]),
+    ("OUTPUT",
+     "Outputs are opened before compute starts so misconfiguration "
+     "fails fast.",
+     ["--output-cluster-definition",
+      "--output-representative-fasta-directory",
+      "--output-representative-fasta-directory-copy",
+      "--output-representative-list"]),
+    ("PERFORMANCE AND RESUMPTION",
+     "Device parallelism (TPU mesh sharding) is automatic; --threads "
+     "only affects host-side FASTA ingestion. Sketches/profiles can "
+     "persist across runs, and long runs can checkpoint and resume.",
+     ["--threads", "--sketch-cache", "--checkpoint-dir",
+      "--profile-trace-dir"]),
+]
+
+_EPILOGS = {
+    "cluster": """\
+EXIT STATUS
+   0 on success, 1 on recoverable user error (bad flags, missing
+   files); unexpected internal errors raise a traceback.
+
+EXAMPLES
+   Dereplicate a directory of MAGs at 95% ANI, writing the cluster
+   table and symlinking representatives:
+
+      galah-tpu cluster -d genomes/ -x fna \\
+         --output-cluster-definition clusters.tsv \\
+         --output-representative-fasta-directory reps/
+
+   Quality-rank with CheckM2 and require 70% completeness:
+
+      galah-tpu cluster -d genomes/ \\
+         --checkm2-quality-report quality_report.tsv \\
+         --min-completeness 70 --max-contamination 10 \\
+         --output-cluster-definition clusters.tsv
+""",
+    "cluster-validate": """\
+EXIT STATUS
+   0 on success (violations are logged as errors, matching the
+   reference's behavior of reporting rather than aborting).
+
+EXAMPLES
+      galah-tpu cluster-validate --cluster-file clusters.tsv --ani 95
+""",
+}
+
+
+def render_full_help(parser: argparse.ArgumentParser,
+                     subcommand: str) -> str:
+    by_flag = {}
+    general = []
+    for action in parser._actions:
+        if not action.option_strings:
+            continue
+        key = action.option_strings[-1]
+        by_flag[key] = action
+        general.append(key)
+
+    out = []
+    prog = f"galah-tpu {subcommand}"
+    out.append(prog.upper())
+    out.append("")
+    out.append("NAME")
+    out.append(_wrap(f"{prog} — {parser.description}"))
+    out.append("")
+
+    used = set()
+    for title, prose, flags in _SECTIONS:
+        present = [f for f in flags if f in by_flag]
+        if not present:
+            continue
+        out.append(title)
+        if prose:
+            out.append(_wrap(prose))
+            out.append("")
+        for f in present:
+            out.append(_format_action(by_flag[f]))
+            used.add(f)
+        out.append("")
+
+    rest = [f for f in general if f not in used and f != "--help"]
+    if rest:
+        out.append("OTHER GENERAL OPTIONS")
+        for f in rest:
+            out.append(_format_action(by_flag[f]))
+        out.append("")
+
+    out.append(_EPILOGS.get(subcommand, ""))
+    return "\n".join(out)
+
+
+def print_full_help(parser: argparse.ArgumentParser,
+                    subcommand: str) -> None:
+    text = render_full_help(parser, subcommand)
+    pager = os.environ.get("PAGER") or "less"
+    if sys.stdout.isatty() and shutil.which(pager.split()[0]):
+        proc = subprocess.Popen([pager.split()[0], "-"] if pager == "less"
+                                else pager.split(),
+                                stdin=subprocess.PIPE)
+        proc.communicate(text.encode())
+    else:
+        sys.stdout.write(text)

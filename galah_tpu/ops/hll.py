@@ -97,7 +97,38 @@ def hll_cardinality(regs: jax.Array) -> jax.Array:
     return _estimate(powsum, zeros, m)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k", "m"))
+def _ani_from_union_stats(
+    powsum: jax.Array,     # f32 (Br, Bc) sum of 2^-union_reg
+    zeros: jax.Array,      # f32 (Br, Bc) count of zero union registers
+    row_cards: jax.Array,  # f32 (Br,) precomputed cardinalities
+    col_cards: jax.Array,  # f32 (Bc,)
+    k: int,
+    m: int,
+) -> jax.Array:
+    u = _estimate(powsum, zeros, m)                  # (Br, Bc)
+    inter = row_cards[:, None] + col_cards[None, :] - u
+    j = jnp.clip(inter / jnp.maximum(u, jnp.float32(1.0)), 0.0, 1.0)
+    ani = 1.0 + jnp.log(2.0 * j / (1.0 + j)) / jnp.float32(k)
+    return jnp.where(j > 0, ani, jnp.float32(0.0))
+
+
+@jax.jit
+def _xla_union_stats(rows_pow2: jax.Array,
+                     cols_pow2: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """XLA fallback for pallas_hll.hll_union_stats_tile (same contract)."""
+    mn = jnp.minimum(rows_pow2[:, None, :], cols_pow2[None, :, :])
+    return mn.sum(-1), (mn == 1.0).astype(jnp.float32).sum(-1)
+
+
+def use_pallas_default() -> bool:
+    """Pallas kernels are the default path on a real TPU backend."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend probing never raises
+        return False
+
+
 def tile_hll_ani(
     rows: jax.Array,       # uint8 (Br, m) registers
     cols: jax.Array,       # uint8 (Bc, m)
@@ -107,16 +138,17 @@ def tile_hll_ani(
 ) -> jax.Array:
     """Mash-style ANI for every (row, col) pair -> (Br, Bc) f32.
 
-    Union registers are the elementwise max (the HLL merge); Jaccard by
-    inclusion-exclusion, clamped to [0, 1]; ANI = 1 + ln(2j/(1+j))/k,
-    0 where the estimated intersection is empty.
+    Union registers are the elementwise max (the HLL merge) — computed as
+    the elementwise MIN of 2^-reg (monotonicity), so the union pass is
+    pure min+add with exp2 hoisted out; Jaccard by inclusion-exclusion,
+    clamped to [0, 1]; ANI = 1 + ln(2j/(1+j))/k, 0 where the estimated
+    intersection is empty.
     """
-    union = jnp.maximum(rows[:, None, :], cols[None, :, :])
-    u = hll_cardinality(union)                       # (Br, Bc)
-    inter = row_cards[:, None] + col_cards[None, :] - u
-    j = jnp.clip(inter / jnp.maximum(u, jnp.float32(1.0)), 0.0, 1.0)
-    ani = 1.0 + jnp.log(2.0 * j / (1.0 + j)) / jnp.float32(k)
-    return jnp.where(j > 0, ani, jnp.float32(0.0))
+    rows_pow2 = jnp.exp2(-rows.astype(jnp.float32))
+    cols_pow2 = jnp.exp2(-cols.astype(jnp.float32))
+    powsum, zeros = _xla_union_stats(rows_pow2, cols_pow2)
+    return _ani_from_union_stats(powsum, zeros, row_cards, col_cards,
+                                 k, rows.shape[-1])
 
 
 def hll_threshold_pairs(
@@ -125,15 +157,28 @@ def hll_threshold_pairs(
     min_ani: float,
     row_tile: int = 64,
     col_tile: int = 256,
+    use_pallas: bool | None = None,
 ) -> dict[Tuple[int, int], float]:
     """Sparse {(i, j): ani} over i<j HLL pairs with ani >= min_ani.
 
     Host-orchestrated upper-triangle tiling; each tile is one device
-    dispatch (register max + estimate + threshold) and only surviving
+    dispatch (union stats + estimate + threshold) and only surviving
     entries come back. The device-side analog of parsing dashing's full
-    TSV matrix (reference: src/dashing.rs:76-100).
+    TSV matrix (reference: src/dashing.rs:76-100). The 2^-reg transform
+    is applied ONCE to the whole matrix; each tile is then a pure
+    min+add reduction — the Pallas kernel (ops/pallas_hll.py) on TPU,
+    an XLA broadcast-min elsewhere.
     """
     import math
+
+    if use_pallas is None:
+        use_pallas = use_pallas_default()
+    if use_pallas:
+        # The Mosaic kernel is compiled/validated at the 128x128 output
+        # tile geometry (square tiles keep the out block at the native
+        # (8,128)-register multiple); other shapes have hit remote-compile
+        # hangs on v5e. Pin the tiling on the pallas path.
+        row_tile = col_tile = 128
 
     n, m = regs_mat.shape
     quantum = math.lcm(row_tile, col_tile)
@@ -142,18 +187,30 @@ def hll_threshold_pairs(
     mat[:n] = regs_mat
     jmat = jnp.asarray(mat)
     cards = hll_cardinality(jmat)
+    pow2 = jnp.exp2(-jmat.astype(jnp.float32))
+
+    if use_pallas:
+        from galah_tpu.ops.pallas_hll import hll_union_stats_tile
+
+        def union_stats(rows, cols):
+            return hll_union_stats_tile(rows, cols,
+                                        chunk=min(1024, m))
+    else:
+        union_stats = _xla_union_stats
 
     out: dict[Tuple[int, int], float] = {}
     for r0 in range(0, n, row_tile):
-        rows = jax.lax.dynamic_slice_in_dim(jmat, r0, row_tile, axis=0)
+        rows = jax.lax.dynamic_slice_in_dim(pow2, r0, row_tile, axis=0)
         rcards = jax.lax.dynamic_slice_in_dim(cards, r0, row_tile, axis=0)
         for c0 in range(r0 - (r0 % col_tile), n, col_tile):
             if c0 + col_tile <= r0:
                 continue
-            cols = jax.lax.dynamic_slice_in_dim(jmat, c0, col_tile, axis=0)
+            cols = jax.lax.dynamic_slice_in_dim(pow2, c0, col_tile, axis=0)
             ccards = jax.lax.dynamic_slice_in_dim(
                 cards, c0, col_tile, axis=0)
-            tile = np.asarray(tile_hll_ani(rows, cols, rcards, ccards, k))
+            powsum, zeros = union_stats(rows, cols)
+            tile = np.asarray(_ani_from_union_stats(
+                powsum, zeros, rcards, ccards, k, m))
             ri, ci = np.nonzero(tile >= min_ani)
             for a, b in zip(ri.tolist(), ci.tolist()):
                 gi, gj = r0 + a, c0 + b

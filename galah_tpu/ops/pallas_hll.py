@@ -1,0 +1,95 @@
+"""Pallas TPU kernel: pairwise HyperLogLog union statistics.
+
+The HLL pairwise pass (the dashing-equivalent precluster hot op,
+reference: src/dashing.rs:76-100) needs, for every sketch pair (r, c),
+the union register sum ``sum_m 2^-max(reg_r, reg_c)`` and the count of
+zero union registers. Since ``2^-x`` is strictly decreasing, the
+register-wise max is the elementwise **min** in pow2 space, so the host
+precomputes ``pow2 = exp2(-regs)`` once per sketch matrix and the kernel
+inner loop is pure VPU min+add — no transcendentals, no gathers.
+
+The kernel tiles the register axis: grid step ``c`` loads a
+(block_rows, chunk) slab of row sketches and a (block_cols, chunk) slab
+of column sketches into VMEM and accumulates into the persistent
+(block_rows, block_cols) output block (constant out index map, init at
+c == 0). VMEM footprint is two input slabs + two output tiles,
+independent of the full register width m.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(rows_ref, cols_ref, powsum_ref, zeros_ref):
+    # Grid (m/chunk,): step c reduces the c-th register chunk of every
+    # row sketch against every column sketch, accumulating into the
+    # persistent (Br, Bc) output blocks (constant out index map, init at
+    # c == 0). The fori loop walks row sketches one at a time so the
+    # live intermediate is (Bc, chunk), never (Br, Bc, chunk).
+    c = pl.program_id(0)
+
+    @pl.when(c == 0)
+    def _():
+        powsum_ref[:] = jnp.zeros_like(powsum_ref)
+        zeros_ref[:] = jnp.zeros_like(zeros_ref)
+
+    cols = cols_ref[:]          # (Bc, chunk) f32
+
+    def body(r, _):
+        row = rows_ref[pl.ds(r, 1), :]                # (1, chunk)
+        mn = jnp.minimum(row, cols)                   # (Bc, chunk)
+        powsum_ref[pl.ds(r, 1), :] += jnp.sum(mn, axis=1)[None, :]
+        zeros_ref[pl.ds(r, 1), :] += jnp.sum(
+            (mn == 1.0).astype(jnp.float32), axis=1)[None, :]
+        return 0
+
+    jax.lax.fori_loop(0, rows_ref.shape[0], body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
+def hll_union_stats_tile(
+    rows_pow2: jax.Array,   # (Br, m) f32, 2^-register
+    cols_pow2: jax.Array,   # (Bc, m) f32
+    chunk: int = 1024,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """(powsum, zeros) f32 (Br, Bc) tiles of the pairwise HLL union.
+
+    ``powsum[r, c] = sum_m 2^-max_reg`` and ``zeros[r, c]`` counts union
+    registers equal to 0 — exactly the two reductions hll._estimate
+    needs. m must be a multiple of ``chunk`` (register widths are powers
+    of two >= 1024 in practice).
+    """
+    br, m = rows_pow2.shape
+    bc = cols_pow2.shape[0]
+    if m % chunk:
+        raise ValueError(f"register width {m} not a multiple of {chunk}")
+    grid = (m // chunk,)
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, chunk), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, chunk), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, bc), lambda c: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, bc), lambda c: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((br, bc), jnp.float32),
+            jax.ShapeDtypeStruct((br, bc), jnp.float32),
+        ],
+        interpret=interpret,
+    )(rows_pow2, cols_pow2)

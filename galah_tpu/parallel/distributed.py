@@ -1,0 +1,139 @@
+"""Multi-host scale-out: jax.distributed init + per-host data sharding.
+
+The reference is strictly single-process shared-memory (SURVEY.md §2.3,
+§5 — rayon threads, no network layer). The TPU-native distributed story
+is SPMD over a global mesh:
+
+  * every host runs this same program; `initialize()` wires them into
+    one JAX runtime (coordinator rendezvous over DCN);
+  * each host ingests and sketches only its shard of the genome list
+    (`host_shard`) — FASTA IO and hashing scale linearly with hosts;
+  * the per-host sketch rows are assembled into one globally-sharded
+    device array (`global_sketch_matrix`) without any host ever holding
+    the full matrix;
+  * the pairwise pass is the same `shard_map` program as single-host
+    (parallel/mesh.py) — XLA inserts all-gathers over ICI within a
+    slice and DCN across slices from the shardings alone.
+
+Single-process runs (including the CPU test mesh) take the same code
+path: initialize() is a no-op, host_shard returns everything, and the
+"global" mesh is the local one.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional, Sequence, TypeVar
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host JAX runtime (no-op when single-process).
+
+    Arguments default from the standard env vars
+    (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID) so
+    launchers can configure hosts uniformly; explicit arguments win.
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+
+    if not coordinator_address or (num_processes or 1) <= 1:
+        logger.debug("Single-process run; skipping jax.distributed")
+        return
+    logger.info("Joining distributed runtime as process %s/%s via %s",
+                process_id, num_processes, coordinator_address)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def host_shard(items: Sequence[T]) -> List[T]:
+    """This host's strided shard of a global work list.
+
+    Strided (rather than contiguous) so quality-ordered genome lists
+    spread evenly: genome sizes correlate with quality rank, and a
+    contiguous split would put all the big genomes on host 0.
+    """
+    return list(items[process_index()::process_count()])
+
+
+def global_mesh(axis_name: str = "i") -> Mesh:
+    """1-D mesh over every device in the job (all hosts)."""
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def global_sketch_matrix(
+    local_rows: np.ndarray,
+    global_n: int,
+    mesh: Mesh,
+    axis_name: str = "i",
+) -> jax.Array:
+    """Assemble per-host sketch rows into one row-sharded global array.
+
+    `local_rows` are this host's rows of the (global_n, K) matrix in
+    host_shard order (strided); they are re-laid out into the
+    contiguous row-sharded global array the pairwise kernels expect.
+    No host ever materializes the full matrix: each host contributes
+    exactly its rows via make_array_from_process_local_data, and the
+    permutation from strided ingestion order to contiguous row order
+    happens on device.
+
+    global_n must be divisible by the mesh size (callers pad with
+    SENTINEL rows, as the pairwise kernels already require).
+    """
+    n_proc = process_count()
+    if global_n % mesh.devices.size:
+        raise ValueError(
+            f"global_n {global_n} not divisible by mesh size "
+            f"{mesh.devices.size}; pad first")
+    if n_proc == 1:
+        sharding = NamedSharding(mesh, P(axis_name, None))
+        return jax.device_put(local_rows, sharding)
+
+    # Strided ingestion order -> contiguous global order: host p holds
+    # global rows [p, p+P, p+2P, ...]. Build the global array in strided
+    # order (host-contiguous blocks), then apply the inverse permutation
+    # on device (an all-to-all XLA resolves from the sharding).
+    sharding = NamedSharding(mesh, P(axis_name, None))
+    strided = jax.make_array_from_process_local_data(
+        sharding, local_rows, (global_n, local_rows.shape[1]))
+    # row g of `strided` is global row (g % P) * ceil + ... : compute the
+    # permutation explicitly instead: strided index s = p * per + q holds
+    # global row q * P + p, where per = global_n // P.
+    per = global_n // n_proc
+    s_idx = np.arange(global_n)
+    g_idx = (s_idx % per) * n_proc + (s_idx // per)
+    inv = np.empty(global_n, dtype=np.int64)
+    inv[g_idx] = s_idx
+
+    @jax.jit
+    def permute(x):
+        out = jax.numpy.take(x, jax.numpy.asarray(inv), axis=0)
+        return jax.lax.with_sharding_constraint(out, sharding)
+
+    return permute(strided)

@@ -36,14 +36,18 @@ def sharded_pair_count(
     min_ani: float,
     mesh: Mesh,
     col_tile: int = 64,
+    row_tile: Optional[int] = None,
 ) -> int:
     """Count i<j sketch pairs with ANI >= min_ani, fully on-mesh.
 
-    One SPMD program: rows sharded over the mesh axis, per-device tile
-    loop over all columns, upper-triangle mask via global row/col ids,
-    and a `psum` over ICI producing the replicated global count. This is
-    the collective-reduction pattern the bigger pipelines reuse (and what
-    dryrun_multichip exercises on a virtual mesh).
+    One SPMD program: rows sharded over the mesh axis, per-device
+    (row tile x col tile) loop over its row shard against all columns,
+    upper-triangle mask via global row/col ids, and a `psum` over ICI
+    producing the replicated global count. Tiling both axes bounds the
+    (row_tile, col_tile, sketch) intermediates regardless of shard size,
+    so a single dispatch covers any N. This is the collective-reduction
+    pattern the bigger pipelines reuse (and what dryrun_multichip
+    exercises on a virtual mesh).
     """
     from galah_tpu.ops.constants import SENTINEL
     from galah_tpu.ops.pairwise import ani_to_jaccard, tile_stats
@@ -52,7 +56,9 @@ def sharded_pair_count(
     n_dev = mesh.devices.size
     import math
 
-    quantum = math.lcm(n_dev, col_tile)
+    if row_tile is None:
+        row_tile = min(64, col_tile)
+    quantum = math.lcm(n_dev * row_tile, col_tile)
     pad_n = -(-n // quantum) * quantum
     mat = np.full((pad_n, sketch_mat.shape[1]), np.uint64(SENTINEL),
                   dtype=np.uint64)
@@ -63,21 +69,26 @@ def sharded_pair_count(
     def spmd(rows_block, all_cols):
         block = rows_block.shape[0]
         row0 = jax.lax.axis_index("i") * block
-        n_tiles = all_cols.shape[0] // col_tile
+        n_rt = block // row_tile
+        n_ct = all_cols.shape[0] // col_tile
 
         def one_tile(t):
+            tr = t // n_ct
+            tc = t % n_ct
+            rows = jax.lax.dynamic_slice_in_dim(
+                rows_block, tr * row_tile, row_tile, axis=0)
             cols = jax.lax.dynamic_slice_in_dim(
-                all_cols, t * col_tile, col_tile, axis=0)
-            common, total = tile_stats(rows_block, cols, sketch_size, k)
+                all_cols, tc * col_tile, col_tile, axis=0)
+            common, total = tile_stats(rows, cols, sketch_size, k)
             passing = (common.astype(jnp.float32)
                        >= j_thr * total.astype(jnp.float32))
             passing = passing & (common > 0)
-            gi = row0 + jnp.arange(block)[:, None]
-            gj = t * col_tile + jnp.arange(col_tile)[None, :]
+            gi = row0 + tr * row_tile + jnp.arange(row_tile)[:, None]
+            gj = tc * col_tile + jnp.arange(col_tile)[None, :]
             mask = (gi < gj) & (gj < n) & (gi < n)
             return jnp.sum((passing & mask).astype(jnp.int32))
 
-        local = jnp.sum(jax.lax.map(one_tile, jnp.arange(n_tiles)))
+        local = jnp.sum(jax.lax.map(one_tile, jnp.arange(n_rt * n_ct)))
         return jax.lax.psum(local, "i")
 
     fn = shard_map(

@@ -1,0 +1,90 @@
+"""Per-stage timing + optional XLA profiler traces.
+
+The reference has no profiling subsystem at all (SURVEY.md §5 —
+observability is leveled logging only). Here every pipeline stage is
+wrapped in a `stage(...)` span; spans nest, accumulate by name, and the
+final report logs one line per stage so a 50k-genome run shows where
+wall-clock went (sketching vs pairwise vs ANI refinement vs host
+clustering).
+
+`trace_context(dir)` additionally captures a TensorBoard-loadable XLA
+profile via jax.profiler (device timelines, HLO cost, HBM traffic) when
+the user passes --profile-trace-dir.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class StageTimer:
+    """Accumulating named wall-clock spans (nesting allowed)."""
+
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self._order: List[str] = []
+        self._t0 = time.perf_counter()
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - start
+            if name not in self._acc:
+                self._acc[name] = 0.0
+                self._counts[name] = 0
+                self._order.append(name)
+            self._acc[name] += dt
+            self._counts[name] += 1
+            logger.debug("stage %s: %.3fs", name, dt)
+
+    def items(self) -> List[Tuple[str, float, int]]:
+        return [(n, self._acc[n], self._counts[n]) for n in self._order]
+
+    def report(self, log: Optional[logging.Logger] = None) -> str:
+        log = log or logger
+        total = time.perf_counter() - self._t0
+        lines = []
+        for name, acc, count in self.items():
+            share = 100.0 * acc / total if total > 0 else 0.0
+            suffix = f" x{count}" if count > 1 else ""
+            lines.append(f"{name}: {acc:.2f}s ({share:.0f}%){suffix}")
+        text = "; ".join(lines) + f"; total {total:.2f}s"
+        log.info("Stage timings: %s", text)
+        return text
+
+
+# Process-wide timer: backends and the engine record into this by
+# default so the CLI gets a full report without threading a timer
+# through every constructor.
+GLOBAL = StageTimer()
+
+
+def stage(name: str):
+    return GLOBAL.stage(name)
+
+
+def reset() -> None:
+    global GLOBAL
+    GLOBAL = StageTimer()
+
+
+@contextlib.contextmanager
+def trace_context(trace_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler trace of the enclosed block when trace_dir is set."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    logger.info("Writing XLA profiler trace to %s", trace_dir)
+    with jax.profiler.trace(trace_dir):
+        yield

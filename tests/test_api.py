@@ -1,0 +1,69 @@
+"""Embeddable library API (api.py) — the reference exports its
+orchestration so CoverM can embed it with renamed flags (reference:
+src/cluster_argument_parsing.rs:84-124)."""
+
+import argparse
+
+import pytest
+
+from galah_tpu.api import (
+    ClustererCommandDefinition,
+    GalahClusterer,
+    add_cluster_arguments,
+    generate_galah_clusterer,
+)
+
+ABISKO = [
+    "abisko4/73.20120800_S1X.13.fna",
+    "abisko4/73.20120600_S2D.19.fna",
+    "abisko4/73.20120700_S3X.12.fna",
+    "abisko4/73.20110800_S2D.13.fna",
+]
+
+
+def test_renamed_flags_parse_and_build():
+    defn = ClustererCommandDefinition(ani="dereplication-ani",
+                                      precluster_ani="rough-ani")
+    parser = argparse.ArgumentParser()
+    add_cluster_arguments(parser, defn)
+    args = parser.parse_args(["--dereplication-ani", "97",
+                              "--rough-ani", "92",
+                              "--cluster-method", "fastani"])
+    assert args.dereplication_ani == 97.0
+    clusterer = generate_galah_clusterer(["x.fna"], vars(args), defn)
+    assert isinstance(clusterer, GalahClusterer)
+    assert clusterer.clusterer.ani_threshold == pytest.approx(0.97)
+    assert clusterer.clusterer.method_name() == "fastani"
+
+
+def test_default_definition_matches_cli_flags():
+    parser = argparse.ArgumentParser()
+    add_cluster_arguments(parser)
+    args = parser.parse_args([])
+    assert args.ani == 95.0
+    assert args.precluster_method == "skani"
+
+
+def test_conflicting_quality_inputs_raise():
+    parser = argparse.ArgumentParser()
+    add_cluster_arguments(parser)
+    args = parser.parse_args(["--checkm-tab-table", "a.tsv",
+                              "--genome-info", "b.csv"])
+    with pytest.raises(ValueError, match="at most one"):
+        generate_galah_clusterer(["x.fna"], vars(args))
+
+
+def test_end_to_end_via_api(ref_data):
+    """Embedding-style use: build from parsed args, run, golden clusters
+    (reference: src/clusterer.rs:481-533 pins [[0,1,3],[2]] at 98)."""
+    parser = argparse.ArgumentParser()
+    add_cluster_arguments(parser)
+    args = parser.parse_args([
+        "--ani", "98", "--precluster-ani", "90",
+        "--precluster-method", "finch", "--cluster-method", "fastani",
+        "--min-aligned-fraction", "20",
+    ])
+    paths = [str(ref_data / n) for n in ABISKO]
+    clusterer = generate_galah_clusterer(paths, vars(args))
+    out = clusterer.cluster()
+    assert sorted(sorted(c) for c in out) == [[0, 1, 3], [2]]

@@ -1,0 +1,110 @@
+"""Checkpoint/resume (cluster/checkpoint.py).
+
+The reference has no resume capability (SURVEY.md §5); here an
+interrupted run must (a) not recompute the distance pass, (b) skip
+finished preclusters, and (c) produce identical clusters to an
+uninterrupted run.
+"""
+
+from typing import List, Sequence
+
+import pytest
+
+from galah_tpu.backends.base import ClusterBackend, PreclusterBackend
+from galah_tpu.cluster import cluster
+from galah_tpu.cluster.cache import PairDistanceCache
+from galah_tpu.cluster.checkpoint import ClusterCheckpoint, run_fingerprint
+
+
+class FakePre(PreclusterBackend):
+    """Synthetic preclusterer over integer 'paths': pairs within the same
+    decade are preclustered (used only by engine/checkpoint tests —
+    production tests use the real backends)."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def method_name(self):
+        return "fake"
+
+    def distances(self, paths: Sequence[str]) -> PairDistanceCache:
+        self.calls += 1
+        cache = PairDistanceCache()
+        vals = [int(p) for p in paths]
+        for i in range(len(vals)):
+            for j in range(i + 1, len(vals)):
+                if vals[i] // 10 == vals[j] // 10:
+                    cache.insert((i, j), 0.95)
+        return cache
+
+
+class FakeCl(ClusterBackend):
+    """ANI = 1 - |a-b|/100 over integer 'paths'."""
+
+    def __init__(self, threshold: float):
+        self._thr = threshold
+        self.pairs_computed: List = []
+
+    def method_name(self):
+        return "fakecl"
+
+    @property
+    def ani_threshold(self):
+        return self._thr
+
+    def calculate_ani_batch(self, pairs):
+        self.pairs_computed.extend(pairs)
+        return [1.0 - abs(int(a) - int(b)) / 100.0 for a, b in pairs]
+
+
+GENOMES = ["1", "3", "9", "11", "19", "40", "42", "77"]
+
+
+def test_resume_skips_distance_pass_and_done_preclusters(tmp_path):
+    fp = run_fingerprint(GENOMES, "fake", "fakecl", 0.95, 0.9)
+
+    pre1 = FakePre()
+    ck1 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    ref = cluster(GENOMES, pre1, FakeCl(0.95), checkpoint=ck1)
+    assert pre1.calls == 1
+
+    # resume: distances loaded from disk, every precluster already done
+    pre2 = FakePre()
+    cl2 = FakeCl(0.95)
+    ck2 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    out = cluster(GENOMES, pre2, cl2, checkpoint=ck2)
+    assert pre2.calls == 0
+    assert cl2.pairs_computed == []
+    assert out == ref
+
+
+def test_changed_fingerprint_starts_fresh(tmp_path):
+    fp1 = run_fingerprint(GENOMES, "fake", "fakecl", 0.95, 0.9)
+    ck1 = ClusterCheckpoint(str(tmp_path / "ck"), fp1)
+    cluster(GENOMES, FakePre(), FakeCl(0.95), checkpoint=ck1)
+
+    fp2 = run_fingerprint(GENOMES, "fake", "fakecl", 0.99, 0.9)
+    pre = FakePre()
+    ck2 = ClusterCheckpoint(str(tmp_path / "ck"), fp2)
+    cluster(GENOMES, pre, FakeCl(0.99), checkpoint=ck2)
+    assert pre.calls == 1  # stale checkpoint discarded, distances re-run
+
+
+def test_checkpointed_equals_uncheckpointed(tmp_path):
+    plain = cluster(GENOMES, FakePre(), FakeCl(0.95))
+    fp = run_fingerprint(GENOMES, "fake", "fakecl", 0.95, 0.9)
+    ck = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    with_ck = cluster(GENOMES, FakePre(), FakeCl(0.95), checkpoint=ck)
+    assert plain == with_ck
+
+
+def test_distance_cache_none_values_roundtrip(tmp_path):
+    fp = run_fingerprint(["a"], "x", "y", 0.9, 0.8)
+    ck = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    cache = PairDistanceCache()
+    cache.insert((0, 1), 0.97)
+    cache.insert((1, 2), None)  # gated-out pair: computed but None
+    ck.save_distances(cache)
+    back = ck.load_distances()
+    assert back == cache
+    assert back.contains((1, 2)) and back.get((1, 2)) is None

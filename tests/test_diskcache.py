@@ -1,0 +1,84 @@
+"""Persistent sketch/profile cache (io/diskcache.py).
+
+The reference re-sketches every genome on every run (SURVEY.md §5 notes
+no checkpoint/caching subsystem exists); the cache must be a pure
+speedup — identical results, keyed on file identity, invalidated when
+the FASTA changes.
+"""
+
+import shutil
+
+import numpy as np
+
+from galah_tpu.backends.fragment_backend import ProfileStore
+from galah_tpu.backends.minhash_backend import SketchStore
+from galah_tpu.io import diskcache
+
+
+def _write_fasta(path, seq):
+    with open(path, "w") as f:
+        f.write(">c1\n")
+        f.write(seq + "\n")
+
+
+def test_cachedir_roundtrip(tmp_path):
+    fasta = tmp_path / "g.fna"
+    _write_fasta(str(fasta), "ACGT" * 500)
+    cache = diskcache.CacheDir(str(tmp_path / "cache"))
+    params = {"k": 21, "seed": 0}
+    assert cache.load(str(fasta), "x", params) is None
+    arrays = {"a": np.arange(5, dtype=np.uint64),
+              "b": np.ones((2, 3), dtype=np.uint8)}
+    cache.store(str(fasta), "x", params, arrays)
+    back = cache.load(str(fasta), "x", params)
+    np.testing.assert_array_equal(back["a"], arrays["a"])
+    np.testing.assert_array_equal(back["b"], arrays["b"])
+    # different params -> different entry
+    assert cache.load(str(fasta), "x", {"k": 15, "seed": 0}) is None
+
+
+def test_cache_invalidated_on_file_change(tmp_path):
+    fasta = tmp_path / "g.fna"
+    _write_fasta(str(fasta), "ACGT" * 500)
+    cache = diskcache.CacheDir(str(tmp_path / "cache"))
+    cache.store(str(fasta), "x", {}, {"a": np.zeros(1)})
+    assert cache.load(str(fasta), "x", {}) is not None
+    # rewrite with different content (size changes)
+    _write_fasta(str(fasta), "ACGTA" * 500)
+    assert cache.load(str(fasta), "x", {}) is None
+
+
+def test_disabled_cache_is_noop(tmp_path):
+    fasta = tmp_path / "g.fna"
+    _write_fasta(str(fasta), "ACGT" * 50)
+    cache = diskcache.CacheDir(None)
+    cache.store(str(fasta), "x", {}, {"a": np.zeros(1)})
+    assert cache.load(str(fasta), "x", {}) is None
+
+
+def test_sketchstore_cache_identical_sketches(tmp_path, ref_data):
+    src = str(ref_data / "set1" / "500kb.fna")
+    fasta = str(tmp_path / "500kb.fna")
+    shutil.copy(src, fasta)
+    cache = diskcache.CacheDir(str(tmp_path / "cache"))
+
+    s1 = SketchStore(sketch_size=1000, k=21, cache=cache).get(fasta)
+    assert cache.misses == 1 and cache.hits == 0
+    # fresh store, same cache dir: must hit and return identical hashes
+    s2 = SketchStore(sketch_size=1000, k=21, cache=cache).get(fasta)
+    assert cache.hits == 1
+    np.testing.assert_array_equal(s1.hashes, s2.hashes)
+
+
+def test_profilestore_cache_identical_profiles(tmp_path, ref_data):
+    src = str(ref_data / "set1" / "500kb.fna")
+    fasta = str(tmp_path / "500kb.fna")
+    shutil.copy(src, fasta)
+    cache = diskcache.CacheDir(str(tmp_path / "cache"))
+
+    p1 = ProfileStore(k=15, fraglen=3000, cache=cache).get(fasta)
+    p2 = ProfileStore(k=15, fraglen=3000, cache=cache).get(fasta)
+    assert cache.hits == 1
+    np.testing.assert_array_equal(p1.flat_hashes, p2.flat_hashes)
+    np.testing.assert_array_equal(p1.ref_set, p2.ref_set)
+    np.testing.assert_array_equal(p1.markers, p2.markers)

@@ -1,0 +1,30 @@
+"""--full-help man-style pages (manpage.py; the reference renders roff
+through `man`, reference: src/cluster_argument_parsing.rs:1194-1263)."""
+
+from galah_tpu import cli
+from galah_tpu.manpage import render_full_help
+
+
+def test_full_help_flag_exits_zero(capsys):
+    assert cli.main(["cluster", "--full-help"]) == 0
+    out = capsys.readouterr().out
+    assert "GENOME INPUT" in out
+    assert "--precluster-method" in out
+    assert "EXAMPLES" in out
+
+
+def test_full_help_validate(capsys):
+    assert cli.main(["cluster-validate", "--full-help"]) == 0
+    out = capsys.readouterr().out
+    assert "--cluster-file" in out
+
+
+def test_every_cluster_flag_appears_in_page():
+    parser = cli.build_parser()
+    sub = parser._subcommand_parsers["cluster"]
+    page = render_full_help(sub, "cluster")
+    for action in sub._actions:
+        for flag in action.option_strings:
+            if flag in ("-h", "--help"):
+                continue
+            assert flag in page, f"{flag} missing from full help"
