@@ -89,6 +89,8 @@ class MinHashPreclusterer(PreclusterBackend):
             mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
         logger.info("Computing tiled all-pairs Mash ANI ..")
         with timing.stage("pairwise-minhash"):
+            # threshold_pairs auto-selects the column-sharded SPMD
+            # implementation on a multi-device runtime
             pairs = threshold_pairs(
                 mat, k=self.k, min_ani=self.min_ani,
                 sketch_size=self.sketch_size)

@@ -1,0 +1,51 @@
+"""Shared host-side loop for blocked sparse extraction.
+
+The three sparse pair-extraction paths (ops/pairwise.threshold_pairs,
+ops/hll.hll_threshold_pairs, parallel/mesh.sharded_threshold_pairs) all
+follow the same shape: one device dispatch per row block returns
+capacity-bounded compacted candidates plus the true passing count; the
+host retries a block whose candidates overflowed. This module owns that
+retry loop so capacity policy lives in exactly one place.
+
+Capacities are always rounded up to a power of two: `cap` is a jit
+static argument, so arbitrary per-block capacities would recompile the
+whole stripe program per block on dense workloads — power-of-two
+rounding bounds distinct compilations to O(log n).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Tuple
+
+
+def _pow2_at_least(x: int) -> int:
+    p = 1
+    while p < x:
+        p <<= 1
+    return p
+
+
+def iter_blocks(
+    n: int,
+    row_tile: int,
+    cap_per_row: int,
+    run_block: Callable[[int, int], Tuple],
+) -> Iterator[Tuple[int, Tuple]]:
+    """Yield (r0, device_result) per row block, retrying on overflow.
+
+    `run_block(r0, cap)` must return a tuple whose LAST element is the
+    true passing count (scalar or per-device array); a max() over it
+    exceeding `cap` triggers a retry with the next power-of-two
+    capacity.
+    """
+    import numpy as np
+
+    for r0 in range(0, n, row_tile):
+        cap = _pow2_at_least(cap_per_row * row_tile)
+        while True:
+            result = run_block(r0, cap)
+            count = int(np.max(np.asarray(result[-1])))
+            if count <= cap:
+                break
+            cap = _pow2_at_least(max(2 * cap, count))
+        yield r0, result

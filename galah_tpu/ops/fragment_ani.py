@@ -253,6 +253,7 @@ def directed_ani_batch(
         key = (wins.shape, refs.shape[0])
         groups.setdefault(key, []).append(n)
 
+    n_dev = jax.device_count()
     for (wshape, _h), idxs in groups.items():
         per_query_elems = wshape[0] * wshape[1]
         b_max = max(1, _BATCH_ELEM_CAP // max(per_query_elems, 1))
@@ -265,10 +266,20 @@ def directed_ani_batch(
                     q.device_windows(), r.device_ref_set())
                 mt = [(matched, total)]
             else:
-                wins = jnp.stack(
-                    [queries[n][0].device_windows() for n in chunk])
-                refs = jnp.stack(
-                    [queries[n][1].device_ref_set() for n in chunk])
+                if n_dev > 1:
+                    # Shard the batch over the mesh: the vmapped
+                    # membership test is embarrassingly parallel per
+                    # directed query, so a batch-dim sharding turns one
+                    # dispatch into n_dev-way data parallelism. Staged
+                    # through host numpy so padding never materializes
+                    # a super-cap array on one device.
+                    wins, refs = _shard_batch(
+                        [queries[n] for n in chunk], n_dev)
+                else:
+                    wins = jnp.stack(
+                        [queries[n][0].device_windows() for n in chunk])
+                    refs = jnp.stack(
+                        [queries[n][1].device_ref_set() for n in chunk])
                 m_b, t_b = _window_match_counts_batched(wins, refs)
                 mt = [(m_b[i], t_b[i]) for i in range(len(chunk))]
             for n, (m, t) in zip(chunk, mt):
@@ -276,6 +287,31 @@ def directed_ani_batch(
                     np.asarray(m), np.asarray(t), queries[n][0],
                     identity_floor, min_window_valid_frac)
     return out  # type: ignore[return-value]
+
+
+def _shard_batch(pairs: "list[Tuple[GenomeProfile, GenomeProfile]]",
+                 n_dev: int):
+    """Batch-dim-sharded (wins, refs) device arrays for (query, ref)
+    pairs, padded to a mesh multiple (padding repeats the first pair;
+    callers index only the real rows).
+
+    The padded batch is assembled in host numpy and device_put straight
+    into its sharded layout, so each device only ever holds its own
+    shard — never the whole super-capacity batch.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from galah_tpu.parallel import make_mesh
+
+    b = len(pairs)
+    b_pad = -(-b // n_dev) * n_dev
+    padded = pairs + [pairs[0]] * (b_pad - b)
+    wins_np = np.stack([pad_windows(q.windows()) for q, _ in padded])
+    refs_np = np.stack([pad_ref_set(r.ref_set) for _, r in padded])
+    mesh = make_mesh()
+    wins = jax.device_put(wins_np, NamedSharding(mesh, P("i", None, None)))
+    refs = jax.device_put(refs_np, NamedSharding(mesh, P("i", None)))
+    return wins, refs
 
 
 def bidirectional_ani_batch(

@@ -158,6 +158,7 @@ def hll_threshold_pairs(
     row_tile: int = 64,
     col_tile: int = 256,
     use_pallas: bool | None = None,
+    cap_per_row: int = 64,
 ) -> dict[Tuple[int, int], float]:
     """Sparse {(i, j): ani} over i<j HLL pairs with ani >= min_ani.
 
@@ -198,22 +199,53 @@ def hll_threshold_pairs(
     else:
         union_stats = _xla_union_stats
 
-    out: dict[Tuple[int, int], float] = {}
-    for r0 in range(0, n, row_tile):
+    n_ct = n_pad // col_tile
+
+    @functools.partial(jax.jit, static_argnames=("cap",))
+    def rowblock(pow2, cards, r0, cap):
+        """One dispatch: the row block's full ANI stripe, thresholded and
+        compacted on device (same blocked-dispatch pattern as
+        ops/pairwise.threshold_pairs)."""
         rows = jax.lax.dynamic_slice_in_dim(pow2, r0, row_tile, axis=0)
         rcards = jax.lax.dynamic_slice_in_dim(cards, r0, row_tile, axis=0)
-        for c0 in range(r0 - (r0 % col_tile), n, col_tile):
-            if c0 + col_tile <= r0:
-                continue
-            cols = jax.lax.dynamic_slice_in_dim(pow2, c0, col_tile, axis=0)
-            ccards = jax.lax.dynamic_slice_in_dim(
-                cards, c0, col_tile, axis=0)
-            powsum, zeros = union_stats(rows, cols)
-            tile = np.asarray(_ani_from_union_stats(
-                powsum, zeros, rcards, ccards, k, m))
-            ri, ci = np.nonzero(tile >= min_ani)
-            for a, b in zip(ri.tolist(), ci.tolist()):
-                gi, gj = r0 + a, c0 + b
-                if gi < gj < n:
-                    out[(gi, gj)] = float(tile[a, b])
+        t_first = r0 // col_tile
+
+        def one_tile(t):
+            def compute(_):
+                cols = jax.lax.dynamic_slice_in_dim(
+                    pow2, t * col_tile, col_tile, axis=0)
+                ccards = jax.lax.dynamic_slice_in_dim(
+                    cards, t * col_tile, col_tile, axis=0)
+                powsum, zeros = union_stats(rows, cols)
+                return _ani_from_union_stats(
+                    powsum, zeros, rcards, ccards, k, m)
+
+            def skip(_):
+                return jnp.zeros((row_tile, col_tile), jnp.float32)
+
+            return jax.lax.cond(t >= t_first, compute, skip, None)
+
+        ani = jax.lax.map(one_tile, jnp.arange(n_ct))
+        ani = jnp.transpose(ani, (1, 0, 2)).reshape(row_tile, n_pad)
+        gi = r0 + jnp.arange(row_tile)[:, None]
+        gj = jnp.arange(n_pad)[None, :]
+        mask = (ani >= jnp.float32(min_ani)) & (gi < gj) & (gj < n)
+        count = jnp.sum(mask.astype(jnp.int32))
+        (flat_idx,) = jnp.nonzero(mask.ravel(), size=cap, fill_value=-1)
+        vals = jnp.take(ani.ravel(), jnp.maximum(flat_idx, 0))
+        return flat_idx, vals, count
+
+    from galah_tpu.ops.compact import iter_blocks
+
+    out: dict[Tuple[int, int], float] = {}
+    for r0, (flat_idx, vals, count) in iter_blocks(
+            n, row_tile, cap_per_row,
+            lambda r0, cap: rowblock(pow2, cards, jnp.int32(r0), cap)):
+        count = int(count)
+        flat_idx = np.asarray(flat_idx)[:count]
+        vals = np.asarray(vals)[:count]
+        gi = r0 + flat_idx // n_pad
+        gj = flat_idx % n_pad
+        for a, b, v in zip(gi.tolist(), gj.tolist(), vals.tolist()):
+            out[(int(a), int(b))] = float(v)
     return out

@@ -1,0 +1,163 @@
+"""Pallas TPU kernel: pairwise merged-bottom-k MinHash statistics.
+
+The finch-equivalent precluster pass needs, for every sketch pair, the
+pair (common, total) of the merged bottom-k distinct union
+(ops/pairwise._pair_stats). The XLA path does a per-pair searchsorted;
+Mosaic has no wide per-lane gather and no 64-bit integers, so the kernel
+recomputes the same quantities from block compares on u32 hi/lo planes:
+
+  * for each 128-element chunk of query sketch `a` (laid out along
+    sublanes via a host-side transpose — no in-kernel relayout), compare
+    against the whole reference sketch `b` broadcast along lanes: u64
+    less-than/equal from lexicographic (hi, lo) compares. Row-sums give
+    ltcnt_i = #{b < a_i} and a match flag per a_i.
+  * union rank of a matched a_i is i + ltcnt_i - (#matches before i);
+    the prefix term comes from log-step shift cumsums (no gathers).
+  * common = matches with union rank < total, total = min(sketch_size,
+    na + nb - n_matches) — bit-identical to the XLA path's integers.
+
+One grid program computes one pair; a (Br, Bc) tile is a (Br, Bc) grid.
+O(K^2) compares per pair instead of O(K log K) gathers — the VPU-
+friendly trade on hardware where gathers are the scarce resource.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+CH = 128  # a-chunk: elements per sublane block
+
+def _inclusive_cumsum_axis0(x: jax.Array) -> jax.Array:
+    """Hillis-Steele prefix sum along sublanes via static shifts."""
+    n = x.shape[0]
+    sh = 1
+    while sh < n:
+        shifted = jnp.concatenate(
+            [jnp.zeros((sh, x.shape[1]), x.dtype), x[:-sh, :]], axis=0)
+        x = x + shifted
+        sh *= 2
+    return x
+
+
+def _inclusive_cumsum_axis1(x: jax.Array) -> jax.Array:
+    n = x.shape[1]
+    sh = 1
+    while sh < n:
+        shifted = jnp.concatenate(
+            [jnp.zeros((x.shape[0], sh), x.dtype), x[:, :-sh]], axis=1)
+        x = x + shifted
+        sh *= 2
+    return x
+
+
+def _make_kernel(k_width: int, sketch_size: int):
+    nch = k_width // CH
+
+    def kernel(a_hi_ref, a_lo_ref, b_hi_ref, b_lo_ref,
+               common_ref, total_ref, lt_scr, match_scr):
+        umax = jnp.uint32(0xFFFFFFFF)
+        bh = b_hi_ref[:]          # (1, K)
+        bl = b_lo_ref[:]
+
+        na = jnp.int32(0)
+        nb = jnp.sum((~((bh == umax) & (bl == umax))).astype(jnp.int32))
+
+        for r in range(nch):
+            ahc = a_hi_ref[r * CH:(r + 1) * CH, :]     # (CH, 1)
+            alc = a_lo_ref[r * CH:(r + 1) * CH, :]
+            # b_j < a_i on u64 via lexicographic u32 halves; sentinel
+            # entries (UMAX, UMAX) are never < anything and only equal
+            # other sentinels, which valid_a masks out.
+            lt = (bh < ahc) | ((bh == ahc) & (bl < alc))     # (CH, K)
+            eq = (bh == ahc) & (bl == alc)
+            ltcnt = jnp.sum(lt.astype(jnp.int32), axis=1, keepdims=True)
+            eqany = jnp.sum(eq.astype(jnp.int32), axis=1, keepdims=True)
+            valid_a = ~((ahc == umax) & (alc == umax))
+            match = ((eqany > 0) & valid_a).astype(jnp.int32)
+            na = na + jnp.sum(valid_a.astype(jnp.int32))
+            lt_scr[:, r:r + 1] = ltcnt
+            match_scr[:, r:r + 1] = match
+
+        match = match_scr[:]      # (CH, nch); a-index = col*CH + row
+        ltv = lt_scr[:]
+        n_common_all = jnp.sum(match)
+        n_union = na + nb - n_common_all
+        total = jnp.minimum(jnp.int32(sketch_size), n_union)
+
+        colsum = jnp.sum(match, axis=0, keepdims=True)        # (1, nch)
+        col_excl = _inclusive_cumsum_axis1(colsum) - colsum   # (1, nch)
+        row_excl = _inclusive_cumsum_axis0(match) - match     # (CH, nch)
+        cexcl = col_excl + row_excl
+
+        s_idx = jax.lax.broadcasted_iota(jnp.int32, (CH, nch), 0)
+        r_idx = jax.lax.broadcasted_iota(jnp.int32, (CH, nch), 1)
+        i_idx = r_idx * CH + s_idx
+        urank = i_idx + ltv - cexcl
+        common = jnp.sum(match * (urank < total).astype(jnp.int32))
+
+        common_ref[0, 0] = common
+        total_ref[0, 0] = total
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("sketch_size", "interpret"))
+def tile_stats_pallas(
+    rows: jax.Array,   # uint64 (Br, K) sorted asc, SENTINEL-padded
+    cols: jax.Array,   # uint64 (Bc, K)
+    sketch_size: int,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """(common, total) int32 (Br, Bc) tiles — the Pallas twin of
+    ops/pairwise.tile_stats (bit-identical integers)."""
+    br, k_in = rows.shape
+    bc = cols.shape[0]
+    k_pad = -(-k_in // CH) * CH
+    if k_pad != k_in:
+        fill = jnp.full((1, k_pad - k_in), ~jnp.uint64(0), jnp.uint64)
+        rows = jnp.concatenate([rows, jnp.tile(fill, (br, 1))], axis=1)
+        cols = jnp.concatenate([cols, jnp.tile(fill, (bc, 1))], axis=1)
+
+    a_hi = (rows >> jnp.uint64(32)).astype(jnp.uint32).T   # (K, Br)
+    a_lo = rows.astype(jnp.uint32).T
+    b_hi = (cols >> jnp.uint64(32)).astype(jnp.uint32)     # (Bc, K)
+    b_lo = cols.astype(jnp.uint32)
+
+    nch = k_pad // CH
+    kernel = _make_kernel(k_pad, sketch_size)
+    return pl.pallas_call(
+        kernel,
+        grid=(br, bc),
+        in_specs=[
+            pl.BlockSpec((k_pad, 1), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, 1), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (i, j),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i, j: (i, j),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((br, bc), jnp.int32),
+            jax.ShapeDtypeStruct((br, bc), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((CH, nch), jnp.int32),
+            pltpu.VMEM((CH, nch), jnp.int32),
+        ],
+        interpret=interpret,
+    )(a_hi, a_lo, b_hi, b_lo)

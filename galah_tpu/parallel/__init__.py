@@ -2,4 +2,5 @@ from galah_tpu.parallel import distributed  # noqa: F401
 from galah_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     sharded_pair_count,
+    sharded_threshold_pairs,
 )

@@ -1,5 +1,12 @@
 """Device-mesh helpers: the distributed execution layer.
 
+`sharded_threshold_pairs` is the production sparse precluster pass: for
+each row block, ONE SPMD dispatch computes the block's (common, total)
+stripe with columns sharded over the mesh, thresholds conservatively and
+compacts on device, and returns per-device candidate lists; the host
+applies the exact f64 check. `sharded_pair_count` is the reduction-only
+variant used by benchmarks and the multi-chip dry run.
+
 The reference's only parallel runtime is a rayon thread pool over shared
 memory (reference: src/cluster_argument_parsing.rs:409-412 and the
 par_iter sites catalogued in SURVEY.md §2.3). The TPU-native equivalent is
@@ -12,6 +19,7 @@ plus a bigger mesh — since shard_map is SPMD over whatever mesh it's given.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -97,3 +105,124 @@ def sharded_pair_count(
         out_specs=P(),
     )
     return int(jax.jit(fn)(jnp.asarray(mat), jnp.asarray(mat)))
+
+
+def sharded_threshold_pairs(
+    sketch_mat: np.ndarray,
+    k: int,
+    min_ani: float,
+    mesh: Mesh,
+    row_tile: int = 64,
+    col_tile: int = 128,
+    cap_per_row: int = 64,
+) -> dict:
+    """Sparse {(i, j): ani} for i<j pairs with ani >= min_ani, columns
+    sharded over the mesh.
+
+    The multi-device twin of ops/pairwise.threshold_pairs: each device
+    owns a contiguous column range of the (replicated) sketch matrix,
+    computes the row block's stats stripe against its range tile by
+    tile (skipping below-diagonal tiles), thresholds conservatively and
+    compacts on device; the host merges the per-device candidate lists
+    and applies the exact f64 integer-Jaccard check. One dispatch per
+    row block regardless of mesh size.
+    """
+    import math
+
+    from galah_tpu.ops.constants import SENTINEL
+    from galah_tpu.ops.pairwise import (
+        ani_to_jaccard,
+        stats_to_ani_f64,
+        tile_stats,
+    )
+
+    n = sketch_mat.shape[0]
+    sketch_size = sketch_mat.shape[1]
+    n_dev = mesh.devices.size
+    quantum = math.lcm(n_dev * col_tile, row_tile)
+    n_pad = -(-n // quantum) * quantum
+    mat = np.full((n_pad, sketch_size), np.uint64(SENTINEL),
+                  dtype=np.uint64)
+    mat[:n] = sketch_mat
+    jmat = jnp.asarray(mat)
+
+    cols_per_dev = n_pad // n_dev
+    tiles_per_dev = cols_per_dev // col_tile
+    j_thr = ani_to_jaccard(min_ani, k)
+    j_thr_lo = jnp.float64(j_thr * (1.0 - 1e-12) - 1e-300)
+
+    def spmd(full, r0, thr_lo, cap):
+        dev = jax.lax.axis_index("i")
+        col0 = dev * cols_per_dev
+        rows = jax.lax.dynamic_slice_in_dim(full, r0, row_tile, axis=0)
+        t_first = r0 // col_tile
+
+        def one_tile(t):
+            gt = col0 // col_tile + t
+
+            def compute(_):
+                cols = jax.lax.dynamic_slice_in_dim(
+                    full, gt * col_tile, col_tile, axis=0)
+                c, tt = tile_stats(rows, cols, sketch_size, k)
+                return c.astype(jnp.int32), tt.astype(jnp.int32)
+
+            def skip(_):
+                # pcast marks the constant zeros as device-varying so the
+                # cond branches type-check under shard_map's vma typing.
+                z = jax.lax.pcast(
+                    jnp.zeros((row_tile, col_tile), jnp.int32),
+                    "i", to="varying")
+                return z, z
+
+            return jax.lax.cond(gt >= t_first, compute, skip, None)
+
+        common, total = jax.lax.map(one_tile, jnp.arange(tiles_per_dev))
+        common = jnp.transpose(common, (1, 0, 2)).reshape(
+            row_tile, cols_per_dev)
+        total = jnp.transpose(total, (1, 0, 2)).reshape(
+            row_tile, cols_per_dev)
+
+        gi = r0 + jnp.arange(row_tile)[:, None]
+        gj = col0 + jnp.arange(cols_per_dev)[None, :]
+        mask = (common.astype(jnp.float64)
+                >= thr_lo * total.astype(jnp.float64))
+        mask &= (common > 0) & (gi < gj) & (gj < n)
+        count = jnp.sum(mask.astype(jnp.int32))
+        (flat_idx,) = jnp.nonzero(mask.ravel(), size=cap, fill_value=-1)
+        safe = jnp.maximum(flat_idx, 0)
+        return (flat_idx[None], jnp.take(common.ravel(), safe)[None],
+                jnp.take(total.ravel(), safe)[None], count[None])
+
+    @functools.partial(jax.jit, static_argnames=("cap",))
+    def run_block(full, r0, thr_lo, cap):
+        fn = shard_map(
+            functools.partial(spmd, cap=cap),
+            mesh=mesh,
+            in_specs=(P(None, None), P(), P()),
+            out_specs=(P("i"), P("i"), P("i"), P("i")),
+        )
+        return fn(full, r0, thr_lo)
+
+    from galah_tpu.ops.compact import iter_blocks
+
+    out: dict = {}
+    for r0, (flat_idx, common, total, counts) in iter_blocks(
+            n, row_tile, cap_per_row,
+            lambda r0, cap: run_block(jmat, jnp.int32(r0), j_thr_lo, cap)):
+        flat_idx = np.asarray(flat_idx)
+        common = np.asarray(common).astype(np.int64)
+        total = np.asarray(total).astype(np.int64)
+        counts = np.asarray(counts)
+        for dev in range(n_dev):
+            cnt = int(counts[dev])
+            fi = flat_idx[dev, :cnt]
+            co = common[dev, :cnt]
+            to = total[dev, :cnt]
+            keep = co.astype(np.float64) >= j_thr * to
+            fi, co, to = fi[keep], co[keep], to[keep]
+            ani = stats_to_ani_f64(co, to, k)
+            gi = r0 + fi // cols_per_dev
+            gj = dev * cols_per_dev + fi % cols_per_dev
+            for a, b, v in zip(gi.tolist(), gj.tolist(), ani.tolist()):
+                out[(int(a), int(b))] = float(v)
+    return out

@@ -61,3 +61,29 @@ def test_sharded_pipeline_from_global_matrix():
     count = sharded_pair_count(mat, k=21, min_ani=0.99, mesh=mesh,
                                col_tile=8)
     assert count == 1
+
+
+def test_sharded_threshold_pairs_matches_single_device():
+    """The 8-device column-sharded sparse extraction must produce the
+    exact same pair dict as ops/pairwise.threshold_pairs."""
+    from galah_tpu.ops.pairwise import threshold_pairs
+    from galah_tpu.parallel import sharded_threshold_pairs
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(3)
+    n, width = 100, 256
+    mat = rng.integers(0, 1 << 63, size=(n, width), dtype=np.uint64)
+    # plant overlapping pairs at various ANI levels
+    mat[10] = mat[4]
+    mat[77, :200] = mat[30, :200]
+    mat[99, :128] = mat[0, :128]
+    mat.sort(axis=1)
+
+    # mesh=make_mesh(1) pins the single-device implementation (on the
+    # 8-device test runtime threshold_pairs would otherwise auto-shard)
+    ref = threshold_pairs(mat, k=21, min_ani=0.9, row_tile=16,
+                          col_tile=32, mesh=make_mesh(1))
+    got = sharded_threshold_pairs(mat, k=21, min_ani=0.9, mesh=mesh,
+                                  row_tile=16, col_tile=32)
+    assert got == ref
+    assert (4, 10) in got

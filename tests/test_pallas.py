@@ -11,7 +11,10 @@ import pytest
 import jax.numpy as jnp
 
 from galah_tpu.ops import hll
+from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.pallas_hll import hll_union_stats_tile
+from galah_tpu.ops.pallas_pairwise import tile_stats_pallas
+from galah_tpu.ops.pairwise import tile_stats
 
 
 @pytest.mark.parametrize("br,bc,m", [(16, 24, 4096), (8, 8, 1024)])
@@ -30,6 +33,37 @@ def test_hll_union_stats_parity(br, bc, m):
     z_ref = (union == 0).sum(-1).astype(np.float64)
     np.testing.assert_allclose(np.asarray(ps), ps_ref, rtol=1e-5)
     np.testing.assert_array_equal(np.asarray(z), z_ref)
+
+
+def _rand_sketches(rng, n, width, n_valid_max):
+    mat = np.full((n, width), np.uint64(SENTINEL), dtype=np.uint64)
+    for i in range(n):
+        nv = int(rng.integers(n_valid_max // 2, n_valid_max + 1))
+        v = np.unique(rng.integers(0, 1 << 64, size=nv, dtype=np.uint64))
+        mat[i, :v.shape[0]] = v
+    return mat
+
+
+@pytest.mark.parametrize("width,sketch_size", [(1000, 1000), (512, 500)])
+def test_minhash_pair_stats_parity(width, sketch_size):
+    """tile_stats_pallas must be bit-identical to the XLA searchsorted
+    path on (common, total) — including short sketches, sentinel padding
+    and heavy overlap."""
+    rng = np.random.default_rng(7)
+    rows = _rand_sketches(rng, 5, width, sketch_size)
+    cols = _rand_sketches(rng, 6, width, sketch_size)
+    cols[0] = rows[0]                       # identical pair
+    half = sketch_size // 2
+    cols[1, :half] = rows[1, :half]         # heavy overlap
+    cols[1].sort()
+
+    c_p, t_p = tile_stats_pallas(jnp.asarray(rows), jnp.asarray(cols),
+                                 sketch_size, interpret=True)
+    c_x, t_x = tile_stats(jnp.asarray(rows), jnp.asarray(cols),
+                          sketch_size, 21)
+    np.testing.assert_array_equal(np.asarray(c_p), np.asarray(c_x))
+    np.testing.assert_array_equal(np.asarray(t_p), np.asarray(t_x))
+    assert int(np.asarray(c_p)[0, 0]) > 0
 
 
 def test_threshold_pairs_pallas_interpret_matches_xla():
