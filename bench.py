@@ -27,8 +27,6 @@ def _sketches(n, sketch_size, seed):
 
 
 def bench_device(mat, k, min_ani=0.95, col_tile=256, repeats=3):
-    import jax
-
     from galah_tpu.parallel import make_mesh, sharded_pair_count
 
     mesh = make_mesh()
@@ -42,6 +40,20 @@ def bench_device(mat, k, min_ani=0.95, col_tile=256, repeats=3):
     dt = (time.perf_counter() - t0) / repeats
     assert count >= 0
     return (n * n) / dt
+
+
+def pick_n(k, sketch_size, budget_s=20.0, n_max=8192):
+    """Calibrate: time a small single-dispatch pass, then choose the
+    largest n whose measured-rate runtime fits the budget. Keeps the
+    benchmark meaningful on fast hardware without ever blowing the
+    driver's timeout on slow paths."""
+    n0 = 256
+    mat = _sketches(n0, sketch_size, seed=9)
+    rate = bench_device(mat, k, repeats=1)
+    n = n0
+    while n < n_max and (2 * n) ** 2 / rate < budget_s:
+        n *= 2
+    return n
 
 
 def bench_host_numpy(mat, k, sketch_size, n_pairs=256):
@@ -59,9 +71,12 @@ def bench_host_numpy(mat, k, sketch_size, n_pairs=256):
 
 
 def main():
+    import os
+
     k = 21
     sketch_size = 1000
-    n = 2048
+    env_n = os.environ.get("GALAH_BENCH_N")
+    n = int(env_n) if env_n else pick_n(k, sketch_size)
     mat = _sketches(n, sketch_size, seed=0)
 
     device_pps = bench_device(mat, k)

@@ -47,14 +47,25 @@ class HLLPreclusterer(PreclusterBackend):
         params = {"p": self.p, "k": self.k, "seed": self.seed}
         regs = np.zeros((n, 1 << self.p), dtype=np.uint8)
         with timing.stage("sketch-hll"):
+            from galah_tpu.io.prefetch import probe_and_prefetch
+
+            index: "dict[str, list[int]]" = {}
             for i, path in enumerate(genome_paths):
+                index.setdefault(path, []).append(i)
+
+            def probe(path):
                 entry = self.cache.load(path, "hll", params)
-                if entry is not None:
-                    regs[i] = entry["regs"]
-                    continue
-                regs[i] = hll.hll_sketch_genome(
-                    read_genome(path), p=self.p, k=self.k, seed=self.seed)
-                self.cache.store(path, "hll", params, {"regs": regs[i]})
+                return entry["regs"] if entry is not None else None
+
+            hits, miss_iter = probe_and_prefetch(
+                genome_paths, probe, read_genome)
+            for path, row in hits.items():
+                regs[index[path]] = row
+            for path, genome in miss_iter:
+                row = hll.hll_sketch_genome(
+                    genome, p=self.p, k=self.k, seed=self.seed)
+                regs[index[path]] = row
+                self.cache.store(path, "hll", params, {"regs": row})
 
         logger.info("Computing tiled all-pairs HLL ANI ..")
         with timing.stage("pairwise-hll"):

@@ -45,22 +45,34 @@ class SketchStore:
         return {"sketch_size": self.sketch_size, "k": self.k,
                 "seed": self.seed}
 
-    def get(self, path: str) -> MinHashSketch:
+    def get_cached(self, path: str) -> Optional[MinHashSketch]:
+        """Sketch from memory or the disk cache only (no FASTA read)."""
         s = self._sketches.get(path)
         if s is not None:
             return s
         entry = self.cache.load(path, "minhash", self._params())
-        if entry is not None:
-            s = MinHashSketch(hashes=entry["hashes"],
-                              sketch_size=self.sketch_size, kmer=self.k)
-        else:
-            s = sketch_genome_device(
-                read_genome(path), sketch_size=self.sketch_size,
-                k=self.k, seed=self.seed)
-            self.cache.store(path, "minhash", self._params(),
-                             {"hashes": s.hashes})
+        if entry is None:
+            return None
+        s = MinHashSketch(hashes=entry["hashes"],
+                          sketch_size=self.sketch_size, kmer=self.k)
         self._sketches[path] = s
         return s
+
+    def put_from_genome(self, path: str, genome) -> MinHashSketch:
+        """Sketch an already-ingested genome and cache it."""
+        s = sketch_genome_device(
+            genome, sketch_size=self.sketch_size, k=self.k,
+            seed=self.seed)
+        self.cache.store(path, "minhash", self._params(),
+                         {"hashes": s.hashes})
+        self._sketches[path] = s
+        return s
+
+    def get(self, path: str) -> MinHashSketch:
+        s = self.get_cached(path)
+        if s is not None:
+            return s
+        return self.put_from_genome(path, read_genome(path))
 
 
 class MinHashPreclusterer(PreclusterBackend):
@@ -85,7 +97,15 @@ class MinHashPreclusterer(PreclusterBackend):
             "Sketching MinHash representations of %d genomes on device ..",
             len(genome_paths))
         with timing.stage("sketch-minhash"):
-            sketches = [self.store.get(p) for p in genome_paths]
+            from galah_tpu.io.prefetch import probe_and_prefetch
+
+            # cache misses: ingestion prefetched on host threads while
+            # the device sketches the previous genome
+            by_path, miss_iter = probe_and_prefetch(
+                genome_paths, self.store.get_cached, read_genome)
+            for p, genome in miss_iter:
+                by_path[p] = self.store.put_from_genome(p, genome)
+            sketches = [by_path[p] for p in genome_paths]
             mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
         logger.info("Computing tiled all-pairs Mash ANI ..")
         with timing.stage("pairwise-minhash"):

@@ -1,0 +1,66 @@
+"""Bounded IO prefetching: overlap host FASTA ingestion with device work.
+
+The sketching loops alternate `read_genome` (host IO + C parser) with a
+device dispatch; a bounded look-ahead pool keeps the next genomes'
+ingestion running while the device sketches the current one (the
+reference gets the same overlap from rayon's par_iter over files,
+reference: src/finch.rs:47 via sketch_files). Depth stays small so a
+50k-genome run never holds more than `depth` parsed genomes in memory.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterator, Sequence, Tuple, TypeVar
+
+T = TypeVar("T")
+V = TypeVar("V")
+
+
+def probe_and_prefetch(
+    paths: Sequence[str],
+    probe: Callable[[str], "V | None"],
+    load_fn: Callable[[str], T],
+    depth: int = 2,
+):
+    """Split unique paths into cache hits and a prefetched miss stream.
+
+    Returns (hits, miss_iter): `hits` maps each unique path whose
+    `probe` returned non-None to that value; `miss_iter` yields
+    (path, load_fn(path)) for the rest with bounded look-ahead. The one
+    dedup + cache-probe + prefetch idiom shared by the sketching
+    backends.
+    """
+    hits = {}
+    misses = []
+    for p in dict.fromkeys(paths):  # de-dup, keep order
+        v = probe(p)
+        if v is None:
+            misses.append(p)
+        else:
+            hits[p] = v
+    return hits, iter_prefetched(misses, load_fn, depth=depth)
+
+
+def iter_prefetched(
+    paths: Sequence[str],
+    load_fn: Callable[[str], T],
+    depth: int = 2,
+) -> Iterator[Tuple[str, T]]:
+    """Yield (path, load_fn(path)) in order, loading up to `depth`
+    ahead on worker threads. Exceptions surface at the failing item's
+    turn, preserving the sequential error behavior."""
+    depth = max(1, int(depth))
+    if not paths:
+        return
+    with ThreadPoolExecutor(max_workers=depth) as pool:
+        pending = []
+        idx = 0
+        for idx in range(min(depth, len(paths))):
+            pending.append(pool.submit(load_fn, paths[idx]))
+        for i, path in enumerate(paths):
+            fut = pending.pop(0)
+            nxt = i + depth
+            if nxt < len(paths):
+                pending.append(pool.submit(load_fn, paths[nxt]))
+            yield path, fut.result()

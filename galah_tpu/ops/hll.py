@@ -159,6 +159,7 @@ def hll_threshold_pairs(
     col_tile: int = 256,
     use_pallas: bool | None = None,
     cap_per_row: int = 64,
+    mesh=None,
 ) -> dict[Tuple[int, int], float]:
     """Sparse {(i, j): ani} over i<j HLL pairs with ani >= min_ani.
 
@@ -171,6 +172,25 @@ def hll_threshold_pairs(
     an XLA broadcast-min elsewhere.
     """
     import math
+
+    # Auto-dispatch to the sharded SPMD implementation only when the
+    # caller left BOTH knobs unset: an explicit use_pallas (or an
+    # explicit mesh) pins the single-device implementation so kernel
+    # parity tests and single-chip callers get what they asked for.
+    if mesh is None and use_pallas is None and jax.device_count() > 1:
+        from galah_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+    if mesh is not None and mesh.devices.size > 1:
+        # Multi-device runtime: the column-sharded SPMD extraction
+        # (parallel/mesh.py) covers the mesh with one dispatch per row
+        # block.
+        from galah_tpu.parallel.mesh import sharded_hll_threshold_pairs
+
+        return sharded_hll_threshold_pairs(
+            regs_mat, k=k, min_ani=min_ani, mesh=mesh,
+            row_tile=row_tile, col_tile=min(col_tile, 128),
+            cap_per_row=cap_per_row)
 
     if use_pallas is None:
         use_pallas = use_pallas_default()

@@ -107,6 +107,100 @@ def sharded_pair_count(
     return int(jax.jit(fn)(jnp.asarray(mat), jnp.asarray(mat)))
 
 
+def _sharded_blocked_extract(
+    mesh: Mesh,
+    arrays,              # tuple of replicated device arrays
+    n: int,
+    n_pad: int,
+    row_tile: int,
+    col_tile: int,
+    cap_per_row: int,
+    slice_rows,          # (arrays, r0) -> per-block row context
+    compute_tile,        # (arrays, rows_ctx, gt) -> tuple of stripes
+    stripe_dtypes,       # dtypes of compute_tile's outputs (for skips)
+    stripe_mask,         # (stripes, ) -> bool pass mask (thresholding)
+):
+    """Core of the column-sharded sparse extractions.
+
+    One SPMD dispatch per row block: every device computes the block's
+    stripes against its contiguous column range tile by tile (lax.cond
+    skips tiles entirely below the diagonal), applies `stripe_mask`
+    plus the upper-triangle/bounds mask, and compacts passing entries
+    to a fixed capacity on device. Yields (gi, gj, payloads) numpy
+    arrays per (row block, device); overflow retry policy comes from
+    ops/compact.iter_blocks.
+    """
+    from galah_tpu.ops.compact import iter_blocks
+
+    n_dev = mesh.devices.size
+    cols_per_dev = n_pad // n_dev
+    tiles_per_dev = cols_per_dev // col_tile
+    n_payload = len(stripe_dtypes)
+
+    def spmd(*args):
+        *arrs, r0, cap = args
+        dev = jax.lax.axis_index("i")
+        col0 = dev * cols_per_dev
+        rows_ctx = slice_rows(arrs, r0)
+        t_first = r0 // col_tile
+
+        def one_tile(t):
+            gt = col0 // col_tile + t
+
+            def compute(_):
+                return tuple(compute_tile(arrs, rows_ctx, gt))
+
+            def skip(_):
+                # pcast marks the constant zeros as device-varying so
+                # the cond branches type-check under shard_map's vma
+                # typing.
+                return tuple(
+                    jax.lax.pcast(
+                        jnp.zeros((row_tile, col_tile), dt),
+                        "i", to="varying")
+                    for dt in stripe_dtypes)
+
+            return jax.lax.cond(gt >= t_first, compute, skip, None)
+
+        stripes = jax.lax.map(one_tile, jnp.arange(tiles_per_dev))
+        stripes = tuple(
+            jnp.transpose(s, (1, 0, 2)).reshape(row_tile, cols_per_dev)
+            for s in stripes)
+
+        gi = r0 + jnp.arange(row_tile)[:, None]
+        gj = col0 + jnp.arange(cols_per_dev)[None, :]
+        mask = stripe_mask(stripes) & (gi < gj) & (gj < n)
+        count = jnp.sum(mask.astype(jnp.int32))
+        (flat_idx,) = jnp.nonzero(mask.ravel(), size=cap, fill_value=-1)
+        safe = jnp.maximum(flat_idx, 0)
+        payloads = tuple(jnp.take(s.ravel(), safe)[None] for s in stripes)
+        return (flat_idx[None], *payloads, count[None])
+
+    @functools.partial(jax.jit, static_argnames=("cap",))
+    def run_block(*args, cap):
+        in_specs = tuple(P(*([None] * a.ndim)) for a in arrays) + (P(),)
+        fn = shard_map(
+            functools.partial(lambda *a, cap: spmd(*a, cap), cap=cap),
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=tuple(P("i") for _ in range(n_payload + 2)),
+        )
+        return fn(*args)
+
+    for r0, result in iter_blocks(
+            n, row_tile, cap_per_row,
+            lambda r0, cap: run_block(*arrays, jnp.int32(r0), cap=cap)):
+        flat_idx = np.asarray(result[0])
+        payloads = [np.asarray(p) for p in result[1:-1]]
+        counts = np.asarray(result[-1])
+        for dev in range(n_dev):
+            cnt = int(counts[dev])
+            fi = flat_idx[dev, :cnt]
+            gi = r0 + fi // cols_per_dev
+            gj = dev * cols_per_dev + fi % cols_per_dev
+            yield gi, gj, tuple(p[dev, :cnt] for p in payloads)
+
+
 def sharded_threshold_pairs(
     sketch_mat: np.ndarray,
     k: int,
@@ -119,13 +213,11 @@ def sharded_threshold_pairs(
     """Sparse {(i, j): ani} for i<j pairs with ani >= min_ani, columns
     sharded over the mesh.
 
-    The multi-device twin of ops/pairwise.threshold_pairs: each device
-    owns a contiguous column range of the (replicated) sketch matrix,
-    computes the row block's stats stripe against its range tile by
-    tile (skipping below-diagonal tiles), thresholds conservatively and
-    compacts on device; the host merges the per-device candidate lists
-    and applies the exact f64 integer-Jaccard check. One dispatch per
-    row block regardless of mesh size.
+    The multi-device twin of ops/pairwise.threshold_pairs: the blocked
+    extraction core computes (common, total) stats stripes per device,
+    prefilters with a conservative f64 threshold on device, and the
+    host applies the exact f64 integer-Jaccard check over the sparse
+    survivors. One dispatch per row block regardless of mesh size.
     """
     import math
 
@@ -146,83 +238,90 @@ def sharded_threshold_pairs(
     mat[:n] = sketch_mat
     jmat = jnp.asarray(mat)
 
-    cols_per_dev = n_pad // n_dev
-    tiles_per_dev = cols_per_dev // col_tile
     j_thr = ani_to_jaccard(min_ani, k)
-    j_thr_lo = jnp.float64(j_thr * (1.0 - 1e-12) - 1e-300)
+    j_thr_lo = j_thr * (1.0 - 1e-12) - 1e-300
 
-    def spmd(full, r0, thr_lo, cap):
-        dev = jax.lax.axis_index("i")
-        col0 = dev * cols_per_dev
-        rows = jax.lax.dynamic_slice_in_dim(full, r0, row_tile, axis=0)
-        t_first = r0 // col_tile
+    def slice_rows(arrs, r0):
+        return jax.lax.dynamic_slice_in_dim(arrs[0], r0, row_tile, axis=0)
 
-        def one_tile(t):
-            gt = col0 // col_tile + t
+    def compute_tile(arrs, rows, gt):
+        cols = jax.lax.dynamic_slice_in_dim(
+            arrs[0], gt * col_tile, col_tile, axis=0)
+        c, t = tile_stats(rows, cols, sketch_size, k)
+        return c.astype(jnp.int32), t.astype(jnp.int32)
 
-            def compute(_):
-                cols = jax.lax.dynamic_slice_in_dim(
-                    full, gt * col_tile, col_tile, axis=0)
-                c, tt = tile_stats(rows, cols, sketch_size, k)
-                return c.astype(jnp.int32), tt.astype(jnp.int32)
-
-            def skip(_):
-                # pcast marks the constant zeros as device-varying so the
-                # cond branches type-check under shard_map's vma typing.
-                z = jax.lax.pcast(
-                    jnp.zeros((row_tile, col_tile), jnp.int32),
-                    "i", to="varying")
-                return z, z
-
-            return jax.lax.cond(gt >= t_first, compute, skip, None)
-
-        common, total = jax.lax.map(one_tile, jnp.arange(tiles_per_dev))
-        common = jnp.transpose(common, (1, 0, 2)).reshape(
-            row_tile, cols_per_dev)
-        total = jnp.transpose(total, (1, 0, 2)).reshape(
-            row_tile, cols_per_dev)
-
-        gi = r0 + jnp.arange(row_tile)[:, None]
-        gj = col0 + jnp.arange(cols_per_dev)[None, :]
+    def stripe_mask(stripes):
+        common, total = stripes
         mask = (common.astype(jnp.float64)
-                >= thr_lo * total.astype(jnp.float64))
-        mask &= (common > 0) & (gi < gj) & (gj < n)
-        count = jnp.sum(mask.astype(jnp.int32))
-        (flat_idx,) = jnp.nonzero(mask.ravel(), size=cap, fill_value=-1)
-        safe = jnp.maximum(flat_idx, 0)
-        return (flat_idx[None], jnp.take(common.ravel(), safe)[None],
-                jnp.take(total.ravel(), safe)[None], count[None])
-
-    @functools.partial(jax.jit, static_argnames=("cap",))
-    def run_block(full, r0, thr_lo, cap):
-        fn = shard_map(
-            functools.partial(spmd, cap=cap),
-            mesh=mesh,
-            in_specs=(P(None, None), P(), P()),
-            out_specs=(P("i"), P("i"), P("i"), P("i")),
-        )
-        return fn(full, r0, thr_lo)
-
-    from galah_tpu.ops.compact import iter_blocks
+                >= jnp.float64(j_thr_lo) * total.astype(jnp.float64))
+        return mask & (common > 0)
 
     out: dict = {}
-    for r0, (flat_idx, common, total, counts) in iter_blocks(
-            n, row_tile, cap_per_row,
-            lambda r0, cap: run_block(jmat, jnp.int32(r0), j_thr_lo, cap)):
-        flat_idx = np.asarray(flat_idx)
-        common = np.asarray(common).astype(np.int64)
-        total = np.asarray(total).astype(np.int64)
-        counts = np.asarray(counts)
-        for dev in range(n_dev):
-            cnt = int(counts[dev])
-            fi = flat_idx[dev, :cnt]
-            co = common[dev, :cnt]
-            to = total[dev, :cnt]
-            keep = co.astype(np.float64) >= j_thr * to
-            fi, co, to = fi[keep], co[keep], to[keep]
-            ani = stats_to_ani_f64(co, to, k)
-            gi = r0 + fi // cols_per_dev
-            gj = dev * cols_per_dev + fi % cols_per_dev
-            for a, b, v in zip(gi.tolist(), gj.tolist(), ani.tolist()):
-                out[(int(a), int(b))] = float(v)
+    for gi, gj, (common, total) in _sharded_blocked_extract(
+            mesh, (jmat,), n, n_pad, row_tile, col_tile, cap_per_row,
+            slice_rows, compute_tile, (jnp.int32, jnp.int32),
+            stripe_mask):
+        common = common.astype(np.int64)
+        total = total.astype(np.int64)
+        keep = common.astype(np.float64) >= j_thr * total
+        gi, gj = gi[keep], gj[keep]
+        ani = stats_to_ani_f64(common[keep], total[keep], k)
+        for a, b, v in zip(gi.tolist(), gj.tolist(), ani.tolist()):
+            out[(int(a), int(b))] = float(v)
+    return out
+
+
+def sharded_hll_threshold_pairs(
+    regs_mat: np.ndarray,
+    k: int,
+    min_ani: float,
+    mesh: Mesh,
+    row_tile: int = 64,
+    col_tile: int = 128,
+    cap_per_row: int = 64,
+) -> dict:
+    """Sparse {(i, j): ani} over HLL register sketches, columns sharded
+    over the mesh — the multi-device twin of ops/hll.hll_threshold_pairs
+    (the same blocked extraction core, with the HLL union estimator as
+    the tile computation)."""
+    import math
+
+    from galah_tpu.ops import hll as hll_ops
+
+    n, m = regs_mat.shape
+    n_dev = mesh.devices.size
+    quantum = math.lcm(n_dev * col_tile, row_tile)
+    n_pad = -(-n // quantum) * quantum
+    mat = np.zeros((n_pad, m), dtype=np.uint8)
+    mat[:n] = regs_mat
+    jmat = jnp.asarray(mat)
+    cards = hll_ops.hll_cardinality(jmat)
+    pow2 = jnp.exp2(-jmat.astype(jnp.float32))
+
+    def slice_rows(arrs, r0):
+        return (jax.lax.dynamic_slice_in_dim(arrs[0], r0, row_tile,
+                                             axis=0),
+                jax.lax.dynamic_slice_in_dim(arrs[1], r0, row_tile,
+                                             axis=0))
+
+    def compute_tile(arrs, rows_ctx, gt):
+        rows, rcards = rows_ctx
+        cols = jax.lax.dynamic_slice_in_dim(
+            arrs[0], gt * col_tile, col_tile, axis=0)
+        ccards = jax.lax.dynamic_slice_in_dim(
+            arrs[1], gt * col_tile, col_tile, axis=0)
+        powsum, zeros = hll_ops._xla_union_stats(rows, cols)
+        return (hll_ops._ani_from_union_stats(
+            powsum, zeros, rcards, ccards, k, m),)
+
+    def stripe_mask(stripes):
+        return stripes[0] >= jnp.float32(min_ani)
+
+    out: dict = {}
+    for gi, gj, (vals,) in _sharded_blocked_extract(
+            mesh, (pow2, cards), n, n_pad, row_tile, col_tile,
+            cap_per_row, slice_rows, compute_tile, (jnp.float32,),
+            stripe_mask):
+        for a, b, v in zip(gi.tolist(), gj.tolist(), vals.tolist()):
+            out[(int(a), int(b))] = float(v)
     return out

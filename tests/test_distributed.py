@@ -87,3 +87,28 @@ def test_sharded_threshold_pairs_matches_single_device():
                                   row_tile=16, col_tile=32)
     assert got == ref
     assert (4, 10) in got
+
+
+def test_sharded_hll_threshold_pairs_matches_single_device():
+    import jax.numpy as jnp
+
+    from galah_tpu.ops import hll
+    from galah_tpu.parallel.mesh import sharded_hll_threshold_pairs
+
+    rng = np.random.default_rng(11)
+    n, p = 50, 10
+    mat = np.zeros((n, 1 << p), dtype=np.uint8)
+    for i in range(n):
+        h = rng.integers(0, 1 << 63, size=40_000, dtype=np.uint64) * 2 + 1
+        mat[i] = np.asarray(hll._hll_update(
+            jnp.zeros((1 << p,), dtype=jnp.uint8), jnp.asarray(h), p))
+    mat[31] = mat[6]
+
+    ref = hll.hll_threshold_pairs(mat, k=21, min_ani=0.95,
+                                  mesh=make_mesh(1), use_pallas=False)
+    got = sharded_hll_threshold_pairs(mat, k=21, min_ani=0.95,
+                                      mesh=make_mesh(8))
+    assert set(got) == set(ref)
+    assert (6, 31) in got
+    for key in got:
+        assert abs(got[key] - ref[key]) < 1e-6

@@ -65,8 +65,13 @@ def test_threshold_pairs_non_dividing_tiles():
         mat[i] = np.asarray(hll._hll_update(
             jnp.zeros((1 << p,), dtype=jnp.uint8), jnp.asarray(h), p))
     mat[69] = mat[16]  # identical pair at the tail
+    # use_pallas=False pins the single-device implementation (the
+    # dynamic_slice clamping path this test guards); the default call
+    # auto-shards on the 8-device test runtime, so this also checks
+    # single-device vs sharded agreement.
     pairs = hll.hll_threshold_pairs(mat, k=21, min_ani=0.99,
-                                    row_tile=64, col_tile=80)
+                                    row_tile=64, col_tile=80,
+                                    use_pallas=False)
     assert (16, 69) in pairs
     ref = hll.hll_threshold_pairs(mat, k=21, min_ani=0.99)
     assert set(pairs) == set(ref)

@@ -1,0 +1,51 @@
+"""Bounded IO prefetch iterator (io/prefetch.py)."""
+
+import threading
+import time
+
+import pytest
+
+from galah_tpu.io.prefetch import iter_prefetched
+
+
+def test_order_and_completeness():
+    paths = [f"p{i}" for i in range(17)]
+    out = list(iter_prefetched(paths, lambda p: p.upper(), depth=3))
+    assert [p for p, _ in out] == paths
+    assert [v for _, v in out] == [p.upper() for p in paths]
+
+
+def test_bounded_lookahead():
+    """Never more than `depth` loads in flight beyond consumption."""
+    lock = threading.Lock()
+    state = {"loaded": 0, "consumed": 0, "max_ahead": 0}
+
+    def load(p):
+        with lock:
+            state["loaded"] += 1
+            ahead = state["loaded"] - state["consumed"]
+            state["max_ahead"] = max(state["max_ahead"], ahead)
+        return p
+
+    for p, _ in iter_prefetched([str(i) for i in range(20)], load,
+                                depth=2):
+        time.sleep(0.001)
+        state["consumed"] += 1
+    assert state["loaded"] == 20
+    assert state["max_ahead"] <= 3  # depth + the one being consumed
+
+
+def test_exception_surfaces_at_failing_item():
+    def load(p):
+        if p == "bad":
+            raise ValueError("boom")
+        return p
+
+    it = iter_prefetched(["a", "bad", "c"], load, depth=2)
+    assert next(it)[0] == "a"
+    with pytest.raises(ValueError, match="boom"):
+        next(it)
+
+
+def test_empty():
+    assert list(iter_prefetched([], lambda p: p)) == []
