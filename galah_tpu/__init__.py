@@ -12,3 +12,16 @@ external C++ binaries.
 __version__ = "0.1.0"
 
 from galah_tpu.config import ClusterConfig, Defaults  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy re-exports of the embeddable API (api.py) so `import
+    # galah_tpu` stays cheap (no jax import) for --version/--help.
+    if name in ("GalahClusterer", "ClustererCommandDefinition",
+                "add_cluster_arguments", "generate_galah_clusterer"):
+        from galah_tpu import api
+
+        return getattr(api, name)
+    # NB: no lazy alias for the cluster() function — it would collide
+    # with the galah_tpu.cluster subpackage; use galah_tpu.cluster.cluster.
+    raise AttributeError(f"module 'galah_tpu' has no attribute {name!r}")

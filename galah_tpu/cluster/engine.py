@@ -39,11 +39,15 @@ from galah_tpu.utils import timing
 logger = logging.getLogger(__name__)
 
 
+DENSE_PRECLUSTER_CAP = 64
+
+
 def cluster(
     genomes: Sequence[str],
     preclusterer: PreclusterBackend,
     clusterer: ClusterBackend,
     checkpoint: Optional["ClusterCheckpoint"] = None,
+    dense_precluster_cap: int = DENSE_PRECLUSTER_CAP,
 ) -> List[List[int]]:
     """Cluster quality-ordered genome paths -> list of index clusters.
 
@@ -55,6 +59,15 @@ def cluster(
     With a `checkpoint` (cluster/checkpoint.py), the distance pass and
     each finished precluster persist to disk; an interrupted run resumes
     from the last completed precluster.
+
+    Preclusters up to `dense_precluster_cap` members compute exact ANI
+    for ALL their precluster-hit pairs in one batched dispatch before
+    the greedy loop (every pair the loop could consult is a hit pair),
+    so the sequential rep scan touches no device at all. The extra ANIs
+    beyond what early exits would have needed are the same waste class
+    as the reference's find_any computing an unpredictable candidate
+    subset (reference: src/clusterer.rs:242-262) — traded here for one
+    round trip per precluster instead of one per genome.
     """
     skip_clusterer = preclusterer.method_name() == clusterer.method_name()
     if skip_clusterer:
@@ -87,11 +100,17 @@ def cluster(
                 continue
             local_cache = pre_cache.transform_ids(members)
             local_genomes = [genomes[g] for g in members]
+            warm_cache = None
+            if (not skip_clusterer
+                    and len(members) <= dense_precluster_cap):
+                warm_cache = _warm_all_hit_pairs(
+                    clusterer, local_cache, local_genomes)
             reps, ani_cache = _find_representatives(
-                clusterer, local_cache, local_genomes, skip_clusterer)
+                clusterer, local_cache, local_genomes, skip_clusterer,
+                warm_cache)
             local_clusters = _find_memberships(
                 clusterer, reps, local_cache, local_genomes, ani_cache,
-                skip_clusterer)
+                skip_clusterer, warm_cache)
             global_clusters = [[members[i] for i in c]
                                for c in local_clusters]
             all_clusters.extend(global_clusters)
@@ -107,11 +126,13 @@ def _batch_ani(
     pre_cache: PairDistanceCache,
     genomes: Sequence[str],
     pairs: Sequence[Tuple[int, int]],
+    warm_cache: Optional[PairDistanceCache] = None,
 ) -> List[Optional[float]]:
     """ANI for local index pairs: precluster reuse or batched backend call.
 
     With matching methods, a precluster-cache hit is authoritative (same
     algorithm, same parameters — reference: src/clusterer.rs:264-279);
+    a `warm_cache` of upfront-computed exact ANIs is consulted next;
     only missing pairs go to the backend.
     """
     out: List[Optional[float]] = [None] * len(pairs)
@@ -119,6 +140,8 @@ def _batch_ani(
     for n, (i, j) in enumerate(pairs):
         if skip_clusterer and pre_cache.contains((i, j)):
             out[n] = pre_cache.get((i, j))
+        elif warm_cache is not None and warm_cache.contains((i, j)):
+            out[n] = warm_cache.get((i, j))
         else:
             to_compute.append((n, (genomes[i], genomes[j])))
     if to_compute:
@@ -128,11 +151,28 @@ def _batch_ani(
     return out
 
 
+def _warm_all_hit_pairs(
+    clusterer: ClusterBackend,
+    pre_cache: PairDistanceCache,
+    genomes: Sequence[str],
+) -> PairDistanceCache:
+    """Exact ANI for every precluster-hit pair in ONE batched dispatch."""
+    keys = sorted(pre_cache.keys())
+    warm = PairDistanceCache()
+    if keys:
+        anis = clusterer.calculate_ani_batch(
+            [(genomes[i], genomes[j]) for i, j in keys])
+        for key, ani in zip(keys, anis):
+            warm.insert(key, ani)
+    return warm
+
+
 def _find_representatives(
     clusterer: ClusterBackend,
     pre_cache: PairDistanceCache,
     genomes: Sequence[str],
     skip_clusterer: bool,
+    warm_cache: Optional[PairDistanceCache] = None,
 ) -> Tuple[Set[int], PairDistanceCache]:
     """Greedy quality-ordered representative selection.
 
@@ -151,7 +191,7 @@ def _find_representatives(
         # reference: src/clusterer.rs:167-177)
         cands.sort(key=lambda t: t[1] if t[1] is not None else -1.0)
         anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes,
-                          [(j, i) for j, _ in cands])
+                          [(j, i) for j, _ in cands], warm_cache)
         is_rep = True
         for (j, _), ani in zip(cands, anis):
             if ani is not None:
@@ -174,6 +214,7 @@ def _find_memberships(
     genomes: Sequence[str],
     ani_cache: PairDistanceCache,
     skip_clusterer: bool,
+    warm_cache: Optional[PairDistanceCache] = None,
 ) -> List[List[int]]:
     """Assign every non-rep to its best (argmax exact ANI) representative.
 
@@ -195,7 +236,8 @@ def _find_memberships(
         for r in rep_list:
             if not ani_cache.contains((i, r)) and pre_cache.contains((i, r)):
                 todo.append((r, i))
-    anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes, todo)
+    anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes, todo,
+                      warm_cache)
     for (r, i), ani in zip(todo, anis):
         ani_cache.insert((r, i), ani)  # None recorded too, as the ref does
 

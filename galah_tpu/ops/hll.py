@@ -189,7 +189,7 @@ def hll_threshold_pairs(
 
         return sharded_hll_threshold_pairs(
             regs_mat, k=k, min_ani=min_ani, mesh=mesh,
-            row_tile=row_tile, col_tile=min(col_tile, 128),
+            row_tile=row_tile, col_tile=col_tile,
             cap_per_row=cap_per_row)
 
     if use_pallas is None:

@@ -267,7 +267,7 @@ def threshold_pairs(
     sketch_size: Optional[int] = None,
     row_tile: int = 64,
     col_tile: int = 128,
-    use_pallas: bool = False,
+    use_pallas: Optional[bool] = None,
     cap_per_row: int = 64,
     mesh: "Optional[Mesh]" = None,
 ) -> dict[tuple[int, int], float]:
@@ -289,7 +289,10 @@ def threshold_pairs(
     (parallel/mesh.sharded_threshold_pairs) is selected automatically;
     pass `mesh` to choose one explicitly.
     """
-    if mesh is None and jax.device_count() > 1:
+    # Auto-shard only when the caller left the knobs unset: explicit
+    # use_pallas (True OR False) pins the single-device implementation,
+    # as does an explicit mesh.
+    if mesh is None and use_pallas is None and jax.device_count() > 1:
         from galah_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh()
@@ -325,7 +328,8 @@ def threshold_pairs(
         return _rowblock_candidates(
             jmat, jnp.int32(r0), j_thr_lo,
             sketch_size=sketch_size, k=k, row_tile=row_tile,
-            col_tile=col_tile, cap=cap, n=n, use_pallas=use_pallas)
+            col_tile=col_tile, cap=cap, n=n,
+            use_pallas=bool(use_pallas))
 
     out: dict[tuple[int, int], float] = {}
     for r0, (flat_idx, common, total, count) in iter_blocks(

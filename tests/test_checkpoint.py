@@ -108,3 +108,28 @@ def test_distance_cache_none_values_roundtrip(tmp_path):
     back = ck.load_distances()
     assert back == cache
     assert back.contains((1, 2)) and back.get((1, 2)) is None
+
+
+def test_dense_precluster_single_dispatch_same_result():
+    """Small preclusters warm ALL hit pairs in one backend call; the
+    clusters must equal the per-genome dispatch path's exactly."""
+    from galah_tpu.cluster.engine import cluster as eng_cluster
+
+    pre = FakePre()
+    cl_dense = FakeCl(0.95)
+    dense = eng_cluster(GENOMES, pre, cl_dense, dense_precluster_cap=64)
+
+    cl_lazy = FakeCl(0.95)
+    lazy = eng_cluster(GENOMES, FakePre(), cl_lazy,
+                       dense_precluster_cap=0)
+    assert dense == lazy
+    # dense path: one calculate_ani_batch call per precluster with hits;
+    # count the calls via a wrapper
+    calls = []
+    cl_counted = FakeCl(0.95)
+    orig = cl_counted.calculate_ani_batch
+    cl_counted.calculate_ani_batch = lambda p: (calls.append(len(p)),
+                                                orig(p))[1]
+    eng_cluster(GENOMES, FakePre(), cl_counted, dense_precluster_cap=64)
+    n_preclusters_with_pairs = 3  # decades 0,1,4 have >=2 members
+    assert len(calls) == n_preclusters_with_pairs
