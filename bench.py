@@ -1,22 +1,125 @@
-"""Benchmark: all-pairs MinHash ANI throughput (genome-pairs/sec).
+"""Benchmark harness: device throughput vs an honest CPU baseline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-The measured op is the framework's hot path — the on-device all-pairs
-sketch comparison replacing the reference's host O(N^2) pair loop
-(reference: src/finch.rs:53-73). The whole N x N pass (pair stats,
-threshold, upper-triangle mask, count reduction) runs as ONE sharded
-device program (parallel/mesh.py: sharded_pair_count), so the number
-reflects device throughput rather than dispatch latency. `vs_baseline`
-is the speedup over the same merged-bottom-k computation single-threaded
-on the host (numpy) — the stand-in for the reference's CPU path (the
-reference publishes no numbers; see BASELINE.md).
+Headline metric: all-pairs MinHash ANI throughput (genome-pairs/sec) —
+the production sparse pair extraction (ops/pairwise.threshold_pairs)
+replacing the reference's host O(N^2) pair loop (reference:
+src/finch.rs:53-73). On TPU this runs the Mosaic pair-stats kernel
+(ops/pallas_pairwise.py); the result dict lands on host, so the timing
+includes real device->host materialization.
+
+Extra stages (reported under "stages", each guarded so one failure
+never loses the line):
+  * pairwise_xla — the same extraction on the XLA searchsorted path;
+  * sketch_bp_per_sec — MinHash sketching on real FASTA bytes
+    (the abisko4 MAGs when available; reference analog: finch
+    sketch_files, src/finch.rs:47);
+  * e2e — full cluster() (ingest -> sketch -> pairwise -> greedy ->
+    exact ANI) on synthetic planted families, BASELINE.md rung-1 class.
+
+Baseline: the SAME merged-bottom-k pair computation compiled by XLA on
+the host CPU (multi-threaded) in a subprocess. There is no Rust
+toolchain in this image, so the reference's compiled-Rust path cannot be
+timed directly; XLA-CPU is the strongest available stand-in and is
+labeled as such ("baseline" field). This replaces round 1's
+single-threaded pure-Python loop, which overstated speedups.
+
+Robustness contract (the driver runs this unattended): the TPU backend
+is probed in a SUBPROCESS with a bounded timeout and one retry, every
+stage has a SIGALRM watchdog, and the JSON line is always printed —
+with an "errors" field when something failed.
 """
 
+import contextlib
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+K = 21
+SKETCH_SIZE = 1000
+
+_CPU_BASELINE_CODE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from galah_tpu.ops.pairwise import tile_stats
+
+n, K_, kmer = 256, %d, %d
+rng = np.random.default_rng(0)
+mat = rng.integers(0, 1 << 63, size=(n, K_), dtype=np.uint64)
+mat.sort(axis=1)
+jm = jnp.asarray(mat)
+jax.block_until_ready(tile_stats(jm, jm, K_, kmer))  # compile + warm
+best = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    jax.block_until_ready(tile_stats(jm, jm, K_, kmer))
+    best = min(best, time.perf_counter() - t0)
+print("RESULT", n * n / best)
+"""
+
+_PROBE_CODE = """
+import jax
+devs = jax.devices()
+assert devs
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+print("RESULT", float((x @ x).sum()))
+"""
+
+
+class StageTimeout(Exception):
+    pass
+
+
+@contextlib.contextmanager
+def watchdog(seconds):
+    """SIGALRM guard: a wedged device call raises instead of hanging."""
+    def handler(signum, frame):
+        raise StageTimeout(f"stage exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(int(seconds))
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def run_sub(code, timeout):
+    """Run python -c `code` with a hard timeout; return RESULT float."""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout, cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT"):
+            return float(line.split()[1])
+    raise RuntimeError(
+        f"subprocess rc={proc.returncode}: {proc.stderr[-500:]}")
+
+
+def probe_backend(timeout=120, retries=1):
+    """True iff a device backend comes up and multiplies in a subprocess."""
+    last = None
+    for _ in range(retries + 1):
+        try:
+            run_sub(_PROBE_CODE, timeout)
+            return True, None
+        except Exception as e:  # noqa: BLE001 - report, don't crash
+            last = f"{type(e).__name__}: {e}"
+    return False, last
 
 
 def _sketches(n, sketch_size, seed):
@@ -26,68 +129,189 @@ def _sketches(n, sketch_size, seed):
     return mat
 
 
-def bench_device(mat, k, min_ani=0.95, col_tile=256, repeats=3):
-    from galah_tpu.parallel import make_mesh, sharded_pair_count
+def bench_extraction(mat, repeats=3, use_pallas=None):
+    """Headline: the production sparse pair extraction, pairs/s.
 
-    mesh = make_mesh()
+    threshold_pairs returns its sparse dict on host, so the timing
+    inherently includes device->host materialization (the axon tunnel's
+    block_until_ready does not actually block, so every bench stage
+    must force a transfer).
+    """
+    from galah_tpu.ops.pairwise import threshold_pairs
+
     n = mat.shape[0]
-    sharded_pair_count(mat, k=k, min_ani=min_ani, mesh=mesh,
-                       col_tile=col_tile)  # warmup + compile
-    t0 = time.perf_counter()
+    threshold_pairs(mat, k=K, min_ani=0.95,
+                    use_pallas=use_pallas)  # warmup + compile
+    best = float("inf")
     for _ in range(repeats):
-        count = sharded_pair_count(mat, k=k, min_ani=min_ani, mesh=mesh,
-                                   col_tile=col_tile)
-    dt = (time.perf_counter() - t0) / repeats
-    assert count >= 0
-    return (n * n) / dt
+        t0 = time.perf_counter()
+        pairs = threshold_pairs(mat, k=K, min_ani=0.95,
+                                use_pallas=use_pallas)
+        best = min(best, time.perf_counter() - t0)
+    assert isinstance(pairs, dict)
+    return (n * n) / best
 
 
-def pick_n(k, sketch_size, budget_s=20.0, n_max=8192):
-    """Calibrate: time a small single-dispatch pass, then choose the
-    largest n whose measured-rate runtime fits the budget. Keeps the
-    benchmark meaningful on fast hardware without ever blowing the
-    driver's timeout on slow paths."""
-    n0 = 256
-    mat = _sketches(n0, sketch_size, seed=9)
-    rate = bench_device(mat, k, repeats=1)
+def pick_n(budget_s=25.0, n_max=8192):
+    """Calibrate: time a small pass, then choose the largest n whose
+    projected runtime fits the budget (never blows the driver timeout)."""
+    n0 = 512
+    rate = bench_extraction(_sketches(n0, SKETCH_SIZE, seed=9), repeats=1)
     n = n0
     while n < n_max and (2 * n) ** 2 / rate < budget_s:
         n *= 2
     return n
 
 
-def bench_host_numpy(mat, k, sketch_size, n_pairs=256):
-    """Single-thread host merged-bottom-k Jaccard as the CPU baseline."""
-    from galah_tpu.ops.minhash_np import MinHashSketch, mash_ani
+def bench_sketching():
+    """MinHash sketching throughput on real FASTA bytes, bp/s."""
+    import glob
 
-    sketches = [MinHashSketch(hashes=row, sketch_size=sketch_size, kmer=k)
-                for row in mat]
-    pairs = [(i, (i * 7 + 1) % len(sketches)) for i in range(n_pairs)]
+    from galah_tpu.io.fasta import read_genome
+    from galah_tpu.ops.minhash import sketch_genome_device
+
+    paths = sorted(glob.glob(
+        "/root/reference/tests/data/abisko4/*.fna"))[:6]
+    if not paths:
+        return None
+    genomes = [read_genome(p) for p in paths]
+    total_bp = sum(int(g.codes.shape[0]) for g in genomes)
+    sketch_genome_device(genomes[0], sketch_size=SKETCH_SIZE, k=K,
+                         seed=0)  # compile
     t0 = time.perf_counter()
-    for i, j in pairs:
-        mash_ani(sketches[i], sketches[j])
+    for g in genomes:
+        sketch_genome_device(g, sketch_size=SKETCH_SIZE, k=K, seed=0)
     dt = time.perf_counter() - t0
-    return len(pairs) / dt
+    return total_bp / dt
+
+
+def _synth_families(n_genomes=48, genome_len=60_000, n_families=12,
+                    mut=0.03, seed=7, outdir=None):
+    """Plant n_families mutated-copy families; returns FASTA paths."""
+    import tempfile
+
+    rng = np.random.default_rng(seed)
+    outdir = outdir or tempfile.mkdtemp(prefix="galah_bench_")
+    alphabet = np.frombuffer(b"ACGT", dtype=np.uint8)
+    paths = []
+    per = n_genomes // n_families
+    for f in range(n_families):
+        base = rng.integers(0, 4, size=genome_len)
+        for m in range(per):
+            seq = base.copy()
+            if m > 0:
+                sites = rng.random(genome_len) < mut
+                seq[sites] = (seq[sites] + rng.integers(
+                    1, 4, size=int(sites.sum()))) % 4
+            p = os.path.join(outdir, f"fam{f}_m{m}.fna")
+            with open(p, "wb") as fh:
+                fh.write(b">contig1\n")
+                fh.write(alphabet[seq].tobytes())
+                fh.write(b"\n")
+            paths.append(p)
+    return paths
+
+
+def bench_e2e():
+    """Full cluster() wall-clock on planted families -> genomes/s."""
+    from galah_tpu.api import generate_galah_clusterer
+
+    paths = _synth_families()
+    values = {"ani": 95.0, "precluster_ani": 90.0,
+              "min_aligned_fraction": 15.0, "fragment_length": 3000,
+              "precluster_method": "finch", "cluster_method": "skani",
+              "threads": 1}
+    t0 = time.perf_counter()
+    clusterer = generate_galah_clusterer(paths, values)
+    clusters = clusterer.cluster()
+    dt = time.perf_counter() - t0
+    assert 1 <= len(clusters) <= len(paths)
+    return len(paths) / dt, len(clusters)
 
 
 def main():
-    import os
-
-    k = 21
-    sketch_size = 1000
-    env_n = os.environ.get("GALAH_BENCH_N")
-    n = int(env_n) if env_n else pick_n(k, sketch_size)
-    mat = _sketches(n, sketch_size, seed=0)
-
-    device_pps = bench_device(mat, k)
-    host_pps = bench_host_numpy(mat, k, sketch_size)
-
-    print(json.dumps({
+    result = {
         "metric": "minhash_allpairs_genome_pairs_per_sec",
-        "value": round(device_pps, 1),
+        "value": 0.0,
         "unit": "pairs/s",
-        "vs_baseline": round(device_pps / host_pps, 2),
-    }))
+        "vs_baseline": None,
+        "baseline": "xla-cpu-multicore tile_stats (no rustc in image; "
+                    "strongest available stand-in for the reference's "
+                    "compiled path)",
+        "stages": {},
+        "errors": [],
+    }
+    stages = result["stages"]
+    errors = result["errors"]
+
+    # 1. CPU baseline in a subprocess (never touches the TPU tunnel).
+    cpu_pps = None
+    try:
+        cpu_pps = run_sub(_CPU_BASELINE_CODE % (SKETCH_SIZE, K),
+                          timeout=300)
+        stages["cpu_baseline_pairs_per_sec"] = round(cpu_pps, 1)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"cpu_baseline: {type(e).__name__}: {e}")
+
+    # 2. Bounded-timeout probe of the device backend, one retry.
+    ok, err = probe_backend()
+    if not ok:
+        errors.append(f"backend probe failed: {err}")
+        print(json.dumps(result))
+        return
+
+    try:
+        import jax
+
+        result["backend"] = jax.default_backend()
+        result["n_devices"] = jax.device_count()
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"backend init: {type(e).__name__}: {e}")
+        print(json.dumps(result))
+        return
+
+    # 3. Headline: the production sparse extraction (Mosaic pair-stats
+    # kernel on TPU) at a size fit to the budget.
+    try:
+        with watchdog(300):
+            env_n = os.environ.get("GALAH_BENCH_N")
+            n = int(env_n) if env_n else pick_n()
+            result["n_genomes"] = n
+            mat = _sketches(n, SKETCH_SIZE, seed=0)
+            result["value"] = round(bench_extraction(mat), 1)
+            if cpu_pps:
+                result["vs_baseline"] = round(result["value"] / cpu_pps, 2)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"pairwise: {type(e).__name__}: {e}")
+
+    # 4. The XLA searchsorted path on a smaller tile, for the record.
+    try:
+        with watchdog(240):
+            mat = _sketches(512, SKETCH_SIZE, seed=0)
+            stages["pairwise_xla_pairs_per_sec"] = round(
+                bench_extraction(mat, repeats=1, use_pallas=False), 1)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"extraction: {type(e).__name__}: {e}")
+
+    # 5. Sketching throughput on real FASTA bytes.
+    try:
+        with watchdog(240):
+            bps = bench_sketching()
+            if bps:
+                stages["sketch_bp_per_sec"] = round(bps, 1)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"sketching: {type(e).__name__}: {e}")
+
+    # 6. End-to-end cluster() on planted families.
+    try:
+        with watchdog(300):
+            gps, n_clusters = bench_e2e()
+            stages["e2e_genomes_per_sec"] = round(gps, 2)
+            stages["e2e_n_clusters"] = n_clusters
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"e2e: {type(e).__name__}: {e}")
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

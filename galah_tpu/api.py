@@ -136,6 +136,10 @@ class GalahClusterer:
     preclusterer: object
     clusterer: object
     checkpoint: Optional[object] = None
+    #: sketch-level backend settings (fed into the checkpoint
+    #: fingerprint so a resume under different sketching params starts
+    #: fresh)
+    backend_params: Dict = dataclasses.field(default_factory=dict)
 
     def cluster(self) -> List[List[int]]:
         from galah_tpu.cluster import cluster as run
@@ -260,5 +264,15 @@ def generate_galah_clusterer(
     else:
         raise ValueError(f"unknown cluster method {cl_method!r}")
 
+    from galah_tpu.backends.fragment_backend import ANI_KMER
+    from galah_tpu.ops.hll import DEFAULT_P
+
+    backend_params = {
+        "minhash": {"sketch_size": Defaults.MINHASH_SKETCH_SIZE,
+                    "k": Defaults.MINHASH_KMER, "seed": 0},
+        "hll": {"p": DEFAULT_P, "k": Defaults.MINHASH_KMER, "seed": 0},
+        "fragment": {"k": ANI_KMER, "fraglen": fraglen,
+                     "screen_identity": SkaniPreclusterer.SCREEN_IDENTITY},
+    }
     return GalahClusterer(genome_paths=genome_paths, preclusterer=pre,
-                          clusterer=cl)
+                          clusterer=cl, backend_params=backend_params)

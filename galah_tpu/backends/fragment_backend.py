@@ -27,6 +27,7 @@ margin; see tests/test_golden_clusters.py.
 from __future__ import annotations
 
 import collections
+import contextlib
 import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -40,7 +41,7 @@ from galah_tpu.io.fasta import read_genome
 from galah_tpu.ops import fragment_ani
 from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.fragment_ani import GenomeProfile
-from galah_tpu.ops.pairwise import tile_intersect_counts
+from galah_tpu.ops.pairwise import screen_pairs
 from galah_tpu.utils import timing
 
 logger = logging.getLogger(__name__)
@@ -69,6 +70,22 @@ class ProfileStore:
 
     def _params(self) -> dict:
         return {"k": self.k, "fraglen": self.fraglen}
+
+    @contextlib.contextmanager
+    def reserve(self, n: int):
+        """Temporarily grow the LRU to a batch's working set (a batch
+        referencing more genomes than maxsize would otherwise rebuild
+        profiles mid-batch), restoring the bound — and evicting the
+        overflow — when the batch is done, so long-running processes
+        don't keep every profile of a 50k-genome run resident."""
+        old = self.maxsize
+        self.maxsize = max(self.maxsize, n)
+        try:
+            yield
+        finally:
+            self.maxsize = old
+            while len(self._cache) > self.maxsize:
+                self._cache.popitem(last=False)
 
     def get(self, path: str) -> GenomeProfile:
         prof = self._cache.get(path)
@@ -115,8 +132,13 @@ class _FragmentANIMixin:
     ) -> List[Optional[float]]:
         """ANI for every path pair via coalesced device dispatches."""
         with timing.stage("profile-genomes"):
-            profs = [(self.store.get(a), self.store.get(b))
-                     for a, b in pairs]
+            # each unique genome is profiled at most once per batch: the
+            # LRU is grown to the batch's working set and paths are
+            # fetched deduplicated before pair assembly
+            unique = list(dict.fromkeys(p for pair in pairs for p in pair))
+            with self.store.reserve(len(unique)):
+                by_path = {p: self.store.get(p) for p in unique}
+            profs = [(by_path[a], by_path[b]) for a, b in pairs]
         with timing.stage("fragment-ani"):
             results = fragment_ani.bidirectional_ani_batch(
                 profs, min_aligned_frac=self.min_aligned_fraction)
@@ -190,42 +212,27 @@ class SkaniPreclusterer(PreclusterBackend):
         logger.info("Profiling %d genomes for skani-style preclustering ..",
                     n)
         with timing.stage("profile-genomes"):
-            profiles = [self.store.get(p) for p in genome_paths]
+            with self.store.reserve(n):
+                profiles = [self.store.get(p) for p in genome_paths]
 
         # Marker matrix: pad each genome's marker sketch to a common width.
         m = max(max((p.markers.shape[0] for p in profiles), default=1), 1)
         m = -(-m // 64) * 64
-        tile = 256
-        n_pad = -(-n // tile) * tile
-        mat = np.full((n_pad, m), np.uint64(SENTINEL), dtype=np.uint64)
-        counts = np.zeros(n_pad, dtype=np.int64)
+        mat = np.full((n, m), np.uint64(SENTINEL), dtype=np.uint64)
+        counts = np.zeros(n, dtype=np.int64)
         for i, p in enumerate(profiles):
             cnt = min(p.markers.shape[0], m)
             mat[i, :cnt] = p.markers[:cnt]
             counts[i] = cnt
 
-        # Tiled screening over the upper triangle — only tile-sized
-        # intersection-count blocks ever materialize (cf. threshold_pairs).
+        # Blocked screening: ONE device dispatch per row block (the same
+        # extraction pattern as threshold_pairs — dispatch count scales
+        # O(N / row_tile), not O((N / tile)^2); auto-shards the columns
+        # over a multi-device mesh).
         logger.info("Screening all pairs by marker containment ..")
         c_floor = self.SCREEN_IDENTITY ** self.store.k
-        jmat = np.asarray(mat)
-        pairs: List[Tuple[int, int]] = []
         with timing.stage("marker-screen"):
-            for r0 in range(0, n, tile):
-                rows = jmat[r0: r0 + tile]
-                for c0 in range(r0, n, tile):
-                    inter = np.asarray(tile_intersect_counts(
-                        rows, jmat[c0: c0 + tile])).astype(np.float64)
-                    denom = np.minimum.outer(
-                        counts[r0: r0 + tile], counts[c0: c0 + tile]
-                    ).astype(np.float64)
-                    with np.errstate(divide="ignore", invalid="ignore"):
-                        containment = np.where(denom > 0, inter / denom, 0.0)
-                    ri, ci = np.nonzero(containment >= c_floor)
-                    for a, b in zip(ri.tolist(), ci.tolist()):
-                        gi, gj = r0 + a, c0 + b
-                        if gi < gj < n:
-                            pairs.append((gi, gj))
+            pairs = screen_pairs(mat, counts, c_floor)
         ii = [p[0] for p in pairs]
         jj = [p[1] for p in pairs]
         logger.info("%d pairs passed screening; computing exact ANI ..",

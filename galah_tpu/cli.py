@@ -127,7 +127,15 @@ def run_cluster(args) -> int:
     # Quality filtering/ordering + backend construction live in the
     # embeddable factory (api.py, reference analog:
     # generate_galah_clusterer, src/cluster_argument_parsing.rs:897-1158)
-    clusterer = generate_galah_clusterer(genomes, vars(args), cache=cache)
+    try:
+        clusterer = generate_galah_clusterer(genomes, vars(args),
+                                             cache=cache)
+    except ValueError as e:
+        # User error (conflicting quality inputs, dRep + --genome-info):
+        # a logged message and exit 1, not a traceback — the reference's
+        # factory bails the same way.
+        logger.error("%s", e)
+        return 1
     genomes = clusterer.genome_paths
 
     # Open output handles before compute (fail fast)
@@ -155,7 +163,8 @@ def run_cluster(args) -> int:
                 parse_percentage(args.precluster_ani, "--precluster-ani"),
                 min_aligned_fraction=parse_percentage(
                     args.min_aligned_fraction, "--min-aligned-fraction"),
-                fragment_length=args.fragment_length))
+                fragment_length=args.fragment_length,
+                backend_params=clusterer.backend_params))
         clusterer.checkpoint = ckpt
 
     logger.info("Clustering %d genomes ..", len(genomes))

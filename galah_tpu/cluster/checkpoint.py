@@ -40,11 +40,18 @@ def run_fingerprint(genomes: Sequence[str], precluster_method: str,
                     cluster_method: str, ani: float,
                     precluster_ani: float,
                     min_aligned_fraction: float = 0.0,
-                    fragment_length: int = 0) -> str:
+                    fragment_length: int = 0,
+                    backend_params: Optional[dict] = None) -> str:
     """Hash of everything that affects clustering results — any change
     invalidates the checkpoint rather than silently resuming stale
-    state."""
+    state. `backend_params` carries sketch-level settings (MinHash
+    sketch_size/k/seed, HLL p, marker-screen threshold, ...) so a resume
+    under different sketching parameters starts fresh; the tool version
+    is always included since kernel changes can shift distances."""
+    import galah_tpu
+
     ident = json.dumps({
+        "version": getattr(galah_tpu, "__version__", "0"),
         "genomes": list(genomes),
         "precluster_method": precluster_method,
         "cluster_method": cluster_method,
@@ -52,6 +59,7 @@ def run_fingerprint(genomes: Sequence[str], precluster_method: str,
         "precluster_ani": precluster_ani,
         "min_aligned_fraction": min_aligned_fraction,
         "fragment_length": fragment_length,
+        "backend_params": backend_params or {},
     }, sort_keys=True)
     return hashlib.sha256(ident.encode()).hexdigest()
 

@@ -63,19 +63,33 @@ class GenomeProfile:
     # lazily cached device-resident padded views (upload once per genome)
     _dev_windows: Optional[jax.Array] = None
     _dev_ref_set: Optional[jax.Array] = None
+    # ... and their padded host twins (computed once, reused by both the
+    # single-device upload and the batch-sharding assembly path)
+    _np_windows_padded: Optional[np.ndarray] = None
+    _np_ref_padded: Optional[np.ndarray] = None
 
     @property
     def n_windows(self) -> int:
         return -(-self.flat_hashes.shape[0] // self.fraglen)
 
+    def padded_windows(self) -> np.ndarray:
+        if self._np_windows_padded is None:
+            self._np_windows_padded = pad_windows(self.windows())
+        return self._np_windows_padded
+
+    def padded_ref_set(self) -> np.ndarray:
+        if self._np_ref_padded is None:
+            self._np_ref_padded = pad_ref_set(self.ref_set)
+        return self._np_ref_padded
+
     def device_windows(self) -> jax.Array:
         if self._dev_windows is None:
-            self._dev_windows = jnp.asarray(pad_windows(self.windows()))
+            self._dev_windows = jnp.asarray(self.padded_windows())
         return self._dev_windows
 
     def device_ref_set(self) -> jax.Array:
         if self._dev_ref_set is None:
-            self._dev_ref_set = jnp.asarray(pad_ref_set(self.ref_set))
+            self._dev_ref_set = jnp.asarray(self.padded_ref_set())
         return self._dev_ref_set
 
     def windows(self) -> np.ndarray:
@@ -248,9 +262,8 @@ def directed_ani_batch(
     out: "list[Optional[DirectedANI]]" = [None] * len(queries)
     groups: "dict[tuple, list[int]]" = {}
     for n, (q, r) in enumerate(queries):
-        wins = q.device_windows()
-        refs = r.device_ref_set()
-        key = (wins.shape, refs.shape[0])
+        # padded host shapes only — no device upload during grouping
+        key = (q.padded_windows().shape, r.padded_ref_set().shape[0])
         groups.setdefault(key, []).append(n)
 
     n_dev = jax.device_count()
@@ -306,8 +319,8 @@ def _shard_batch(pairs: "list[Tuple[GenomeProfile, GenomeProfile]]",
     b = len(pairs)
     b_pad = -(-b // n_dev) * n_dev
     padded = pairs + [pairs[0]] * (b_pad - b)
-    wins_np = np.stack([pad_windows(q.windows()) for q, _ in padded])
-    refs_np = np.stack([pad_ref_set(r.ref_set) for _, r in padded])
+    wins_np = np.stack([q.padded_windows() for q, _ in padded])
+    refs_np = np.stack([r.padded_ref_set() for _, r in padded])
     mesh = make_mesh()
     wins = jax.device_put(wins_np, NamedSharding(mesh, P("i", None, None)))
     refs = jax.device_put(refs_np, NamedSharding(mesh, P("i", None)))

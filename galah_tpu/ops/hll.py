@@ -151,6 +151,58 @@ def tile_hll_ani(
                                  k, rows.shape[-1])
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "row_tile", "col_tile", "use_pallas", "cap"))
+def _hll_rowblock(pow2, cards, r0, min_ani, n, *, k, row_tile, col_tile,
+                  use_pallas, cap):
+    """One dispatch: a row block's full ANI stripe, thresholded and
+    compacted on device (same blocked-dispatch pattern as
+    ops/pairwise.threshold_pairs). Module-level so the jit cache is
+    shared across calls (keyed on shapes + the static tiling knobs, not
+    on a per-call closure identity)."""
+    m = pow2.shape[1]
+    n_pad = pow2.shape[0]
+    n_ct = n_pad // col_tile
+
+    if use_pallas:
+        from galah_tpu.ops.pallas_hll import hll_union_stats_tile
+
+        def union_stats(rows, cols):
+            return hll_union_stats_tile(rows, cols, chunk=min(1024, m))
+    else:
+        union_stats = _xla_union_stats
+
+    rows = jax.lax.dynamic_slice_in_dim(pow2, r0, row_tile, axis=0)
+    rcards = jax.lax.dynamic_slice_in_dim(cards, r0, row_tile, axis=0)
+    t_first = r0 // col_tile
+
+    def one_tile(t):
+        def compute(_):
+            cols = jax.lax.dynamic_slice_in_dim(
+                pow2, t * col_tile, col_tile, axis=0)
+            ccards = jax.lax.dynamic_slice_in_dim(
+                cards, t * col_tile, col_tile, axis=0)
+            powsum, zeros = union_stats(rows, cols)
+            return _ani_from_union_stats(
+                powsum, zeros, rcards, ccards, k, m)
+
+        def skip(_):
+            return jnp.zeros((row_tile, col_tile), jnp.float32)
+
+        return jax.lax.cond(t >= t_first, compute, skip, None)
+
+    ani = jax.lax.map(one_tile, jnp.arange(n_ct))
+    ani = jnp.transpose(ani, (1, 0, 2)).reshape(row_tile, n_pad)
+    gi = r0 + jnp.arange(row_tile)[:, None]
+    gj = jnp.arange(n_pad)[None, :]
+    mask = (ani >= min_ani) & (gi < gj) & (gj < n)
+    count = jnp.sum(mask.astype(jnp.int32))
+    (flat_idx,) = jnp.nonzero(mask.ravel(), size=cap, fill_value=-1)
+    vals = jnp.take(ani.ravel(), jnp.maximum(flat_idx, 0))
+    return flat_idx, vals, count
+
+
 def hll_threshold_pairs(
     regs_mat: np.ndarray,
     k: int,
@@ -210,57 +262,15 @@ def hll_threshold_pairs(
     cards = hll_cardinality(jmat)
     pow2 = jnp.exp2(-jmat.astype(jnp.float32))
 
-    if use_pallas:
-        from galah_tpu.ops.pallas_hll import hll_union_stats_tile
-
-        def union_stats(rows, cols):
-            return hll_union_stats_tile(rows, cols,
-                                        chunk=min(1024, m))
-    else:
-        union_stats = _xla_union_stats
-
-    n_ct = n_pad // col_tile
-
-    @functools.partial(jax.jit, static_argnames=("cap",))
-    def rowblock(pow2, cards, r0, cap):
-        """One dispatch: the row block's full ANI stripe, thresholded and
-        compacted on device (same blocked-dispatch pattern as
-        ops/pairwise.threshold_pairs)."""
-        rows = jax.lax.dynamic_slice_in_dim(pow2, r0, row_tile, axis=0)
-        rcards = jax.lax.dynamic_slice_in_dim(cards, r0, row_tile, axis=0)
-        t_first = r0 // col_tile
-
-        def one_tile(t):
-            def compute(_):
-                cols = jax.lax.dynamic_slice_in_dim(
-                    pow2, t * col_tile, col_tile, axis=0)
-                ccards = jax.lax.dynamic_slice_in_dim(
-                    cards, t * col_tile, col_tile, axis=0)
-                powsum, zeros = union_stats(rows, cols)
-                return _ani_from_union_stats(
-                    powsum, zeros, rcards, ccards, k, m)
-
-            def skip(_):
-                return jnp.zeros((row_tile, col_tile), jnp.float32)
-
-            return jax.lax.cond(t >= t_first, compute, skip, None)
-
-        ani = jax.lax.map(one_tile, jnp.arange(n_ct))
-        ani = jnp.transpose(ani, (1, 0, 2)).reshape(row_tile, n_pad)
-        gi = r0 + jnp.arange(row_tile)[:, None]
-        gj = jnp.arange(n_pad)[None, :]
-        mask = (ani >= jnp.float32(min_ani)) & (gi < gj) & (gj < n)
-        count = jnp.sum(mask.astype(jnp.int32))
-        (flat_idx,) = jnp.nonzero(mask.ravel(), size=cap, fill_value=-1)
-        vals = jnp.take(ani.ravel(), jnp.maximum(flat_idx, 0))
-        return flat_idx, vals, count
-
     from galah_tpu.ops.compact import iter_blocks
 
     out: dict[Tuple[int, int], float] = {}
     for r0, (flat_idx, vals, count) in iter_blocks(
             n, row_tile, cap_per_row,
-            lambda r0, cap: rowblock(pow2, cards, jnp.int32(r0), cap)):
+            lambda r0, cap: _hll_rowblock(
+                pow2, cards, jnp.int32(r0), jnp.float32(min_ani),
+                jnp.int32(n), k=k, row_tile=row_tile, col_tile=col_tile,
+                use_pallas=use_pallas, cap=cap)):
         count = int(count)
         flat_idx = np.asarray(flat_idx)[:count]
         vals = np.asarray(vals)[:count]

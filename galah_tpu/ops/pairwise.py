@@ -138,8 +138,14 @@ def all_pairs_ani(
     The reference walks i<j pairs on host threads; here the whole matrix is
     one sharded device computation (upper-triangle extraction happens in
     `threshold_pairs`). For very large N prefer `threshold_pairs`, which
-    never materializes the full matrix on host.
+    never materializes the full matrix on host — N is capped here so an
+    API caller cannot accidentally allocate an O(N^2) host matrix.
     """
+    n_genomes = sketch_mat.shape[0]
+    if n_genomes > 16384:
+        raise ValueError(
+            f"all_pairs_ani materializes a dense ({n_genomes}, "
+            f"{n_genomes}) matrix; use threshold_pairs for large N")
     if sketch_size is None:
         sketch_size = sketch_mat.shape[1]
     if mesh is None:
@@ -260,6 +266,126 @@ def _rowblock_candidates(
             jnp.take(total.ravel(), safe), count)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("row_tile", "col_tile", "cap"))
+def _rowblock_screen(
+    jmat: jax.Array,     # (n_pad, M) uint64 padded marker matrix
+    counts: jax.Array,   # (n_pad,) int32 marker counts per genome
+    r0: jax.Array,       # scalar i32: first global row of this block
+    c_floor_lo: jax.Array,  # f64: conservative (lowered) containment floor
+    n: jax.Array,        # scalar i32: true genome count
+    row_tile: int,
+    col_tile: int,
+    cap: int,
+):
+    """One device dispatch: a (row_tile, n_pad) marker-intersection
+    stripe, containment-thresholded and compacted on device.
+
+    Returns (flat_idx (cap,), inter (cap,), count) — flat_idx indexes the
+    (row_tile, n_pad) stripe, inter is the raw intersection count so the
+    host can apply the EXACT f64 containment check.
+    """
+    n_pad = jmat.shape[0]
+    rows = jax.lax.dynamic_slice_in_dim(jmat, r0, row_tile, axis=0)
+    n_ct = n_pad // col_tile
+    t_first = r0 // col_tile
+
+    def one_tile(t):
+        def compute(_):
+            cols = jax.lax.dynamic_slice_in_dim(
+                jmat, t * col_tile, col_tile, axis=0)
+            return tile_intersect_counts(rows, cols).astype(jnp.int32)
+
+        def skip(_):
+            return jnp.zeros((row_tile, col_tile), jnp.int32)
+
+        return jax.lax.cond(t >= t_first, compute, skip, None)
+
+    inter = jax.lax.map(one_tile, jnp.arange(n_ct))
+    inter = jnp.transpose(inter, (1, 0, 2)).reshape(row_tile, n_pad)
+
+    rcnt = jax.lax.dynamic_slice_in_dim(counts, r0, row_tile, axis=0)
+    denom = jnp.minimum(rcnt[:, None], counts[None, :])
+    gi = r0 + jnp.arange(row_tile)[:, None]
+    gj = jnp.arange(n_pad)[None, :]
+    mask = (inter.astype(jnp.float64)
+            >= c_floor_lo * denom.astype(jnp.float64))
+    mask &= (inter > 0) & (gi < gj) & (gj < n)
+    count = jnp.sum(mask.astype(jnp.int32))
+    (flat_idx,) = jnp.nonzero(mask.ravel(), size=cap, fill_value=-1)
+    return (flat_idx, jnp.take(inter.ravel(), jnp.maximum(flat_idx, 0)),
+            count)
+
+
+def screen_pairs(
+    marker_mat: np.ndarray,   # (N, M) uint64 sorted SENTINEL-padded markers
+    counts: np.ndarray,       # (N,) marker counts per genome
+    c_floor: float,
+    row_tile: int = 64,
+    col_tile: int = 256,
+    cap_per_row: int = 256,
+    mesh: "Optional[Mesh]" = None,
+) -> list[tuple[int, int]]:
+    """i<j pairs whose marker containment >= c_floor, blocked on device.
+
+    Containment = |markers_i ∩ markers_j| / min(|markers_i|, |markers_j|)
+    — the skani-equivalent candidate screen (reference: src/skani.rs:54-70,
+    screen_refs(0.80, ..)). ONE device dispatch per row block: the block's
+    intersection stripe is computed tile-by-tile on device (lax.map),
+    thresholded conservatively there, and only compacted candidates come
+    back; the host applies the exact f64 containment check. On a
+    multi-device runtime the column-sharded SPMD twin
+    (parallel/mesh.sharded_screen_pairs) is selected automatically.
+    """
+    if mesh is None and jax.device_count() > 1:
+        from galah_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+    if mesh is not None and mesh.devices.size > 1:
+        from galah_tpu.parallel.mesh import sharded_screen_pairs
+
+        return sharded_screen_pairs(
+            marker_mat, counts, c_floor, mesh=mesh,
+            row_tile=row_tile, col_tile=col_tile,
+            cap_per_row=cap_per_row)
+
+    import math
+
+    n = marker_mat.shape[0]
+    quantum = math.lcm(row_tile, col_tile)
+    n_pad = -(-n // quantum) * quantum
+    mat = np.full((n_pad, marker_mat.shape[1]),
+                  np.uint64(SENTINEL), dtype=np.uint64)
+    mat[:n] = marker_mat
+    cnt = np.zeros(n_pad, dtype=np.int32)
+    cnt[:n] = counts
+    jmat = jnp.asarray(mat)
+    jcnt = jnp.asarray(cnt)
+
+    c_floor_lo = jnp.float64(c_floor * (1.0 - 1e-12) - 1e-300)
+    counts64 = np.asarray(counts, dtype=np.int64)
+
+    from galah_tpu.ops.compact import iter_blocks
+
+    out: list[tuple[int, int]] = []
+    for r0, (flat_idx, inter, count) in iter_blocks(
+            n, row_tile, cap_per_row,
+            lambda r0, cap: _rowblock_screen(
+                jmat, jcnt, jnp.int32(r0), c_floor_lo, jnp.int32(n),
+                row_tile=row_tile, col_tile=col_tile, cap=cap)):
+        count = int(count)
+        flat_idx = np.asarray(flat_idx)[:count]
+        inter = np.asarray(inter)[:count].astype(np.int64)
+        gi = r0 + flat_idx // n_pad
+        gj = flat_idx % n_pad
+        # exact host-side containment check
+        denom = np.minimum(counts64[gi], counts64[gj]).astype(np.float64)
+        keep = inter.astype(np.float64) >= c_floor * denom
+        out.extend(zip(gi[keep].tolist(), gj[keep].tolist()))
+    return out
+
+
 def threshold_pairs(
     sketch_mat: np.ndarray,
     k: int,
@@ -301,11 +427,51 @@ def threshold_pairs(
 
         return sharded_threshold_pairs(
             sketch_mat, k=k, min_ani=min_ani, mesh=mesh,
+            sketch_size=sketch_size,
             row_tile=row_tile, col_tile=col_tile,
-            cap_per_row=cap_per_row)
+            cap_per_row=cap_per_row, use_pallas=use_pallas)
+
+    if use_pallas is None:
+        from galah_tpu.ops.hll import use_pallas_default
+
+        use_pallas = use_pallas_default()
+    if use_pallas:
+        # The Mosaic kernel's program covers 8 query rows x all columns
+        # of its call; wider column tiles amortize dispatch overhead
+        # (VMEM residency for the reference planes caps the width).
+        row_tile, col_tile = 128, 512
 
     if sketch_size is None:
         sketch_size = sketch_mat.shape[1]
+    try:
+        return _threshold_pairs_single(
+            sketch_mat, k, min_ani, sketch_size, row_tile, col_tile,
+            bool(use_pallas), cap_per_row)
+    except Exception:
+        if not use_pallas:
+            raise
+        # The Mosaic kernel failing to lower (driver/toolchain drift)
+        # must never take down the default path: fall back to XLA.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "Pallas pair-stats kernel unavailable; falling back to the "
+            "XLA searchsorted path", exc_info=True)
+        return _threshold_pairs_single(
+            sketch_mat, k, min_ani, sketch_size, 64, 128, False,
+            cap_per_row)
+
+
+def _threshold_pairs_single(
+    sketch_mat: np.ndarray,
+    k: int,
+    min_ani: float,
+    sketch_size: int,
+    row_tile: int,
+    col_tile: int,
+    use_pallas: bool,
+    cap_per_row: int,
+) -> dict[tuple[int, int], float]:
     n = sketch_mat.shape[0]
     import math
 
@@ -329,7 +495,7 @@ def threshold_pairs(
             jmat, jnp.int32(r0), j_thr_lo,
             sketch_size=sketch_size, k=k, row_tile=row_tile,
             col_tile=col_tile, cap=cap, n=n,
-            use_pallas=bool(use_pallas))
+            use_pallas=use_pallas)
 
     out: dict[tuple[int, int], float] = {}
     for r0, (flat_idx, common, total, count) in iter_blocks(

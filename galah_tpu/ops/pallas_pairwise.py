@@ -1,24 +1,34 @@
 """Pallas TPU kernel: pairwise merged-bottom-k MinHash statistics.
 
 The finch-equivalent precluster pass needs, for every sketch pair, the
-pair (common, total) of the merged bottom-k distinct union
-(ops/pairwise._pair_stats). The XLA path does a per-pair searchsorted;
-Mosaic has no wide per-lane gather and no 64-bit integers, so the kernel
-recomputes the same quantities from block compares on u32 hi/lo planes:
+(common, total) stats of the merged bottom-k distinct union
+(ops/pairwise._pair_stats). The XLA path does a per-pair u64
+searchsorted — gather-heavy and 64-bit-emulated, both scarce on TPU.
+This kernel recomputes the same integers from dense block compares on
+u32 hi/lo planes, the VPU-friendly trade: O(K^2) vectorized compares
+per pair instead of O(K log K) gathers.
 
-  * for each 128-element chunk of query sketch `a` (laid out along
-    sublanes via a host-side transpose — no in-kernel relayout), compare
-    against the whole reference sketch `b` broadcast along lanes: u64
-    less-than/equal from lexicographic (hi, lo) compares. Row-sums give
-    ltcnt_i = #{b < a_i} and a match flag per a_i.
-  * union rank of a matched a_i is i + ltcnt_i - (#matches before i);
-    the prefix term comes from log-step shift cumsums (no gathers).
-  * common = matches with union rank < total, total = min(sketch_size,
-    na + nb - n_matches) — bit-identical to the XLA path's integers.
+Layouts (chosen so every BlockSpec is legal under Mosaic's (8, 128)
+tiling rule — blocks either tile-align or span the full axis, and all
+dynamic indexing happens on sublane (second-minor) dims, never lanes):
 
-One grid program computes one pair; a (Br, Bc) tile is a (Br, Bc) grid.
-O(K^2) compares per pair instead of O(K log K) gathers — the VPU-
-friendly trade on hardware where gathers are the scarce resource.
+  * query sketches `a`: (Br*8, K/8) — query i's k-mer k = l*8 + s sits
+    at row i*8+s, lane l: one query is a dynamically sliceable (8, K/8)
+    sublane group, and a CHUNK of 8 consecutive sorted values is one
+    static lane column (8, 1);
+  * reference sketches `b`: (Bc*(K/128), 128) — reference j's chunk s
+    (128 consecutive sorted values) is the dynamically sliceable row
+    j*(K/128)+s;
+  * outputs: (Br, Bc) int32 in (8, 128)-aligned VMEM blocks.
+
+One grid program computes an (8, Bc) output stripe: fori loops walk the
+8 query rows and all references; per pair, a static loop over a-chunks
+and a fori loop over b-chunks accumulate, via broadcast (8, 1) x
+(1, 128) compares, both #(b < a_i) and #(b == a_i) per query element;
+union ranks come from log-step prefix sums exactly as in the XLA path.
+Per-pair scalars land in the output lane vector via one-hot
+accumulation (dynamic lane stores don't exist on TPU). Bit-identical
+integers to ops/pairwise.tile_stats.
 """
 
 from __future__ import annotations
@@ -31,7 +41,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-CH = 128  # a-chunk: elements per sublane block
+A_SUB = 8     # a-chunk height: consecutive sketch values per lane column
+B_LANE = 128  # b-chunk width: consecutive sketch values per sublane row
+ROWS_PER_PROGRAM = 8
+
 
 def _inclusive_cumsum_axis0(x: jax.Array) -> jax.Array:
     """Hillis-Steele prefix sum along sublanes via static shifts."""
@@ -56,55 +69,132 @@ def _inclusive_cumsum_axis1(x: jax.Array) -> jax.Array:
     return x
 
 
-def _make_kernel(k_width: int, sketch_size: int):
-    nch = k_width // CH
+
+def _ssum_i32(x) -> jax.Array:
+    """Scalar int32 sum that survives Mosaic lowering under x64: the
+    scalar-reduce proxy in the Mosaic lowering re-sums WITHOUT a dtype
+    (promoting to int64, unsupported on TPU), so keep every reduction's
+    output non-scalar — one axis at a time, keepdims, explicit dtype —
+    and only then extract the scalar."""
+    s = jnp.sum(x.astype(jnp.int32), axis=1, keepdims=True,
+                dtype=jnp.int32)
+    s = jnp.sum(s, axis=0, keepdims=True, dtype=jnp.int32)
+    return s[0, 0]
+
+def _make_kernel(la: int, sb: int, bc: int, sketch_size: int):
+    """Kernel for K = 8*la = 128*sb padded sketch width.
+
+    One program: rp=8 queries (a 64-sublane block) against all bc
+    references. The compare loop batches ALL 8 queries into each
+    (64, 128) vector op, so per-pair cost is one-eighth of a
+    query-at-a-time formulation; the rank epilogue then runs per query
+    on (8, la) slices.
+    """
+    rp = ROWS_PER_PROGRAM
+    nrows = rp * A_SUB  # 64
 
     def kernel(a_hi_ref, a_lo_ref, b_hi_ref, b_lo_ref,
-               common_ref, total_ref, lt_scr, match_scr):
+               common_ref, total_ref, lt_scr, eq_scr):
         umax = jnp.uint32(0xFFFFFFFF)
-        bh = b_hi_ref[:]          # (1, K)
-        bl = b_lo_ref[:]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, bc), 1)
+        subl = jax.lax.broadcasted_iota(jnp.int32, (rp, bc), 0)
+        ah = a_hi_ref[:]          # (64, la); query q row group q*8..q*8+7
+        al = a_lo_ref[:]
+        valid_a = ~((ah == umax) & (al == umax))
+        # per-query valid counts, computed once per program
+        na_q = [
+            _ssum_i32(valid_a[q * A_SUB:(q + 1) * A_SUB, :])
+            for q in range(rp)
+        ]
 
-        na = jnp.int32(0)
-        nb = jnp.sum((~((bh == umax) & (bl == umax))).astype(jnp.int32))
+        def j_body(j, carry):
+            crows, trows = carry      # (rp, bc) int32 accumulators
 
-        for r in range(nch):
-            ahc = a_hi_ref[r * CH:(r + 1) * CH, :]     # (CH, 1)
-            alc = a_lo_ref[r * CH:(r + 1) * CH, :]
-            # b_j < a_i on u64 via lexicographic u32 halves; sentinel
-            # entries (UMAX, UMAX) are never < anything and only equal
-            # other sentinels, which valid_a masks out.
-            lt = (bh < ahc) | ((bh == ahc) & (bl < alc))     # (CH, K)
-            eq = (bh == ahc) & (bl == alc)
-            ltcnt = jnp.sum(lt.astype(jnp.int32), axis=1, keepdims=True)
-            eqany = jnp.sum(eq.astype(jnp.int32), axis=1, keepdims=True)
-            valid_a = ~((ahc == umax) & (alc == umax))
-            match = ((eqany > 0) & valid_a).astype(jnp.int32)
-            na = na + jnp.sum(valid_a.astype(jnp.int32))
-            lt_scr[:, r:r + 1] = ltcnt
-            match_scr[:, r:r + 1] = match
+            # reference j's valid count (shared by all queries)
+            nb = jnp.int32(0)
+            for s in range(sb):
+                bh = b_hi_ref[pl.ds(j * sb + s, 1), :]
+                bl = b_lo_ref[pl.ds(j * sb + s, 1), :]
+                nb = nb + _ssum_i32(~((bh == umax) & (bl == umax)))
 
-        match = match_scr[:]      # (CH, nch); a-index = col*CH + row
-        ltv = lt_scr[:]
-        n_common_all = jnp.sum(match)
-        n_union = na + nb - n_common_all
-        total = jnp.minimum(jnp.int32(sketch_size), n_union)
+            # compare loop: for each a-chunk column l, all 8 queries'
+            # chunk-l elements (64, 1) against every b chunk (1, 128);
+            # u64 compares from lexicographic (hi, lo) u32 halves.
+            # Sentinel b entries (UMAX, UMAX) are never < a valid value
+            # and only equal other sentinels, which valid_a masks out.
+            for l in range(la):
+                a_h = ah[:, l:l + 1]  # (64, 1) — static lane slice
+                a_l = al[:, l:l + 1]
+                ltacc = jnp.zeros((nrows, B_LANE), jnp.int32)
+                eqacc = jnp.zeros((nrows, B_LANE), jnp.int32)
+                for s in range(sb):
+                    bh = b_hi_ref[pl.ds(j * sb + s, 1), :]   # (1, 128)
+                    bl = b_lo_ref[pl.ds(j * sb + s, 1), :]
+                    lt = (bh < a_h) | ((bh == a_h) & (bl < a_l))
+                    eq = (bh == a_h) & (bl == a_l)           # (64, 128)
+                    ltacc = ltacc + lt.astype(jnp.int32)
+                    eqacc = eqacc + eq.astype(jnp.int32)
+                lt_scr[:, l:l + 1] = jnp.sum(
+                    ltacc, axis=1, keepdims=True, dtype=jnp.int32)
+                eq_scr[:, l:l + 1] = jnp.sum(
+                    eqacc, axis=1, keepdims=True, dtype=jnp.int32)
 
-        colsum = jnp.sum(match, axis=0, keepdims=True)        # (1, nch)
-        col_excl = _inclusive_cumsum_axis1(colsum) - colsum   # (1, nch)
-        row_excl = _inclusive_cumsum_axis0(match) - match     # (CH, nch)
-        cexcl = col_excl + row_excl
+            ltv_all = lt_scr[:]
+            eqv_all = eq_scr[:]
+            hot = (lane == j).astype(jnp.int32)              # (1, bc)
 
-        s_idx = jax.lax.broadcasted_iota(jnp.int32, (CH, nch), 0)
-        r_idx = jax.lax.broadcasted_iota(jnp.int32, (CH, nch), 1)
-        i_idx = r_idx * CH + s_idx
-        urank = i_idx + ltv - cexcl
-        common = jnp.sum(match * (urank < total).astype(jnp.int32))
+            # rank epilogue per query on its (8, la) slice
+            for q in range(rp):
+                sl = slice(q * A_SUB, (q + 1) * A_SUB)
+                ltv = ltv_all[sl, :]
+                eqv = eqv_all[sl, :]
+                va = valid_a[sl, :]
+                match = ((eqv > 0) & va).astype(jnp.int32)
+                n_common_all = _ssum_i32(match)
+                n_union = na_q[q] + nb - n_common_all
+                total = jnp.minimum(jnp.int32(sketch_size), n_union)
 
-        common_ref[0, 0] = common
-        total_ref[0, 0] = total
+                # union rank of matched a_i (i = l*8 + s): i + #(b<a_i)
+                # - #(matches before i), via log-step shift cumsums
+                colsum = jnp.sum(match, axis=0, keepdims=True,
+                                 dtype=jnp.int32)             # (1, la)
+                col_excl = _inclusive_cumsum_axis1(colsum) - colsum
+                row_excl = _inclusive_cumsum_axis0(match) - match
+                cexcl = col_excl + row_excl
+
+                s_idx = jax.lax.broadcasted_iota(
+                    jnp.int32, (A_SUB, la), 0)
+                l_idx = jax.lax.broadcasted_iota(
+                    jnp.int32, (A_SUB, la), 1)
+                i_idx = l_idx * A_SUB + s_idx
+                urank = i_idx + ltv - cexcl
+                common = _ssum_i32(
+                    match * (urank < total).astype(jnp.int32))
+
+                qmask = (subl == q).astype(jnp.int32)         # (rp, bc)
+                crows = crows + qmask * (hot * common)
+                trows = trows + qmask * (hot * total)
+            return crows, trows
+
+        crows, trows = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(bc), j_body,
+            (jnp.zeros((rp, bc), jnp.int32),
+             jnp.zeros((rp, bc), jnp.int32)))
+        common_ref[:] = crows
+        total_ref[:] = trows
 
     return kernel
+
+
+def _zi(i):
+    """Index-map zero with the grid index's own dtype: a literal 0 in an
+    index map canonicalizes to int64 under x64, which Mosaic rejects."""
+    return i * 0
+
+
+def _split_planes(mat: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    return ((mat >> jnp.uint64(32)).astype(jnp.uint32),
+            mat.astype(jnp.uint32))
 
 
 @functools.partial(jax.jit,
@@ -117,47 +207,91 @@ def tile_stats_pallas(
 ) -> Tuple[jax.Array, jax.Array]:
     """(common, total) int32 (Br, Bc) tiles — the Pallas twin of
     ops/pairwise.tile_stats (bit-identical integers)."""
-    br, k_in = rows.shape
-    bc = cols.shape[0]
-    k_pad = -(-k_in // CH) * CH
+    br_in, k_in = rows.shape
+    bc_in = cols.shape[0]
+    sent = ~jnp.uint64(0)
+
+    # The reference side resides fully in VMEM (bc * k_pad * 8 bytes of
+    # u32 planes); chunk the columns when it would overflow.
+    k_pad_probe = -(-k_in // B_LANE) * B_LANE
+    bc_limit = max(B_LANE, (4 << 20) // (k_pad_probe * 8))
+    bc_limit = (bc_limit // B_LANE) * B_LANE
+    if bc_in > bc_limit:
+        parts = [
+            tile_stats_pallas(rows, cols[c0:c0 + bc_limit], sketch_size,
+                              interpret=interpret)
+            for c0 in range(0, bc_in, bc_limit)
+        ]
+        return (jnp.concatenate([p[0] for p in parts], axis=1),
+                jnp.concatenate([p[1] for p in parts], axis=1))
+
+    k_pad = -(-k_in // B_LANE) * B_LANE
     if k_pad != k_in:
-        fill = jnp.full((1, k_pad - k_in), ~jnp.uint64(0), jnp.uint64)
-        rows = jnp.concatenate([rows, jnp.tile(fill, (br, 1))], axis=1)
-        cols = jnp.concatenate([cols, jnp.tile(fill, (bc, 1))], axis=1)
+        fill = jnp.full((1, k_pad - k_in), sent, jnp.uint64)
+        rows = jnp.concatenate(
+            [rows, jnp.tile(fill, (br_in, 1))], axis=1)
+        cols = jnp.concatenate(
+            [cols, jnp.tile(fill, (bc_in, 1))], axis=1)
 
-    a_hi = (rows >> jnp.uint64(32)).astype(jnp.uint32).T   # (K, Br)
-    a_lo = rows.astype(jnp.uint32).T
-    b_hi = (cols >> jnp.uint64(32)).astype(jnp.uint32)     # (Bc, K)
-    b_lo = cols.astype(jnp.uint32)
+    # Pad rows to the program height, cols to the output lane quantum.
+    br = -(-br_in // ROWS_PER_PROGRAM) * ROWS_PER_PROGRAM
+    bc = -(-bc_in // B_LANE) * B_LANE
+    if br != br_in:
+        rows = jnp.concatenate(
+            [rows, jnp.full((br - br_in, k_pad), sent, jnp.uint64)],
+            axis=0)
+    if bc != bc_in:
+        cols = jnp.concatenate(
+            [cols, jnp.full((bc - bc_in, k_pad), sent, jnp.uint64)],
+            axis=0)
 
-    nch = k_pad // CH
-    kernel = _make_kernel(k_pad, sketch_size)
-    return pl.pallas_call(
+    la = k_pad // A_SUB
+    sb = k_pad // B_LANE
+
+    # a: (Br, K) -> (Br*8, la); query i's value k = l*8 + s at
+    # (row i*8 + s, lane l)
+    a_hi, a_lo = _split_planes(rows)
+    a_hi2 = a_hi.reshape(br, la, A_SUB).transpose(0, 2, 1).reshape(
+        br * A_SUB, la)
+    a_lo2 = a_lo.reshape(br, la, A_SUB).transpose(0, 2, 1).reshape(
+        br * A_SUB, la)
+    # b: (Bc, K) -> (Bc*sb, 128); ref j's chunk s (k = s*128 + l) at
+    # row j*sb + s
+    b_hi, b_lo = _split_planes(cols)
+    b_hi2 = b_hi.reshape(bc * sb, B_LANE)
+    b_lo2 = b_lo.reshape(bc * sb, B_LANE)
+
+    kernel = _make_kernel(la, sb, bc, sketch_size)
+    rp = ROWS_PER_PROGRAM
+    common, total = pl.pallas_call(
         kernel,
-        grid=(br, bc),
+        grid=(br // rp,),
         in_specs=[
-            pl.BlockSpec((k_pad, 1), lambda i, j: (0, i),
+            pl.BlockSpec((rp * A_SUB, la), lambda i: (i, _zi(i)),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((k_pad, 1), lambda i, j: (0, i),
+            pl.BlockSpec((rp * A_SUB, la), lambda i: (i, _zi(i)),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k_pad), lambda i, j: (j, 0),
+            pl.BlockSpec((bc * sb, B_LANE),
+                         lambda i: (_zi(i), _zi(i)),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k_pad), lambda i, j: (j, 0),
+            pl.BlockSpec((bc * sb, B_LANE),
+                         lambda i: (_zi(i), _zi(i)),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1), lambda i, j: (i, j),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda i, j: (i, j),
-                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((rp, bc), lambda i: (i, _zi(i)),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rp, bc), lambda i: (i, _zi(i)),
+                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((br, bc), jnp.int32),
             jax.ShapeDtypeStruct((br, bc), jnp.int32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((CH, nch), jnp.int32),
-            pltpu.VMEM((CH, nch), jnp.int32),
+            pltpu.VMEM((rp * A_SUB, la), jnp.int32),
+            pltpu.VMEM((rp * A_SUB, la), jnp.int32),
         ],
         interpret=interpret,
-    )(a_hi, a_lo, b_hi, b_lo)
+    )(a_hi2, a_lo2, b_hi2, b_lo2)
+    return common[:br_in, :bc_in], total[:br_in, :bc_in]

@@ -45,6 +45,7 @@ def sharded_pair_count(
     mesh: Mesh,
     col_tile: int = 64,
     row_tile: Optional[int] = None,
+    use_pallas: Optional[bool] = None,
 ) -> int:
     """Count i<j sketch pairs with ANI >= min_ani, fully on-mesh.
 
@@ -58,14 +59,20 @@ def sharded_pair_count(
     exercises on a virtual mesh).
     """
     from galah_tpu.ops.constants import SENTINEL
+    from galah_tpu.ops.hll import use_pallas_default
     from galah_tpu.ops.pairwise import ani_to_jaccard, tile_stats
+
+    if use_pallas is None:
+        use_pallas = use_pallas_default()
+    if use_pallas:
+        col_tile = max(col_tile, 128)
 
     n = sketch_mat.shape[0]
     n_dev = mesh.devices.size
     import math
 
     if row_tile is None:
-        row_tile = min(64, col_tile)
+        row_tile = min(64, col_tile) if not use_pallas else 128
     quantum = math.lcm(n_dev * row_tile, col_tile)
     pad_n = -(-n // quantum) * quantum
     mat = np.full((pad_n, sketch_mat.shape[1]), np.uint64(SENTINEL),
@@ -73,6 +80,15 @@ def sharded_pair_count(
     mat[:n] = sketch_mat
     j_thr = jnp.float32(ani_to_jaccard(min_ani, k))
     sketch_size = sketch_mat.shape[1]
+
+    if use_pallas:
+        from galah_tpu.ops.pallas_pairwise import tile_stats_pallas
+
+        def stats_fn(rows, cols):
+            return tile_stats_pallas(rows, cols, sketch_size)
+    else:
+        def stats_fn(rows, cols):
+            return tile_stats(rows, cols, sketch_size, k)
 
     def spmd(rows_block, all_cols):
         block = rows_block.shape[0]
@@ -87,7 +103,7 @@ def sharded_pair_count(
                 rows_block, tr * row_tile, row_tile, axis=0)
             cols = jax.lax.dynamic_slice_in_dim(
                 all_cols, tc * col_tile, col_tile, axis=0)
-            common, total = tile_stats(rows, cols, sketch_size, k)
+            common, total = stats_fn(rows, cols)
             passing = (common.astype(jnp.float32)
                        >= j_thr * total.astype(jnp.float32))
             passing = passing & (common > 0)
@@ -206,9 +222,11 @@ def sharded_threshold_pairs(
     k: int,
     min_ani: float,
     mesh: Mesh,
+    sketch_size: Optional[int] = None,
     row_tile: int = 64,
     col_tile: int = 128,
     cap_per_row: int = 64,
+    use_pallas: Optional[bool] = None,
 ) -> dict:
     """Sparse {(i, j): ani} for i<j pairs with ani >= min_ani, columns
     sharded over the mesh.
@@ -218,7 +236,44 @@ def sharded_threshold_pairs(
     prefilters with a conservative f64 threshold on device, and the
     host applies the exact f64 integer-Jaccard check over the sparse
     survivors. One dispatch per row block regardless of mesh size.
+    With use_pallas (the default on a TPU backend) each device's stats
+    tiles run the Mosaic kernel instead of the XLA searchsorted path —
+    bit-identical integers either way.
     """
+    from galah_tpu.ops.hll import use_pallas_default
+
+    if use_pallas is None:
+        use_pallas = use_pallas_default()
+    if use_pallas:
+        try:
+            return _sharded_threshold_pairs_impl(
+                sketch_mat, k, min_ani, mesh, sketch_size, 128, 128,
+                cap_per_row, True)
+        except Exception:
+            # A Mosaic lowering failure must not take down the
+            # multi-device production path either (the single-device
+            # twin has the same fallback).
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "Pallas pair-stats kernel unavailable on the sharded "
+                "path; falling back to XLA", exc_info=True)
+    return _sharded_threshold_pairs_impl(
+        sketch_mat, k, min_ani, mesh, sketch_size, row_tile, col_tile,
+        cap_per_row, False)
+
+
+def _sharded_threshold_pairs_impl(
+    sketch_mat: np.ndarray,
+    k: int,
+    min_ani: float,
+    mesh: Mesh,
+    sketch_size: Optional[int],
+    row_tile: int,
+    col_tile: int,
+    cap_per_row: int,
+    use_pallas: bool,
+) -> dict:
     import math
 
     from galah_tpu.ops.constants import SENTINEL
@@ -229,11 +284,12 @@ def sharded_threshold_pairs(
     )
 
     n = sketch_mat.shape[0]
-    sketch_size = sketch_mat.shape[1]
+    if sketch_size is None:
+        sketch_size = sketch_mat.shape[1]
     n_dev = mesh.devices.size
     quantum = math.lcm(n_dev * col_tile, row_tile)
     n_pad = -(-n // quantum) * quantum
-    mat = np.full((n_pad, sketch_size), np.uint64(SENTINEL),
+    mat = np.full((n_pad, sketch_mat.shape[1]), np.uint64(SENTINEL),
                   dtype=np.uint64)
     mat[:n] = sketch_mat
     jmat = jnp.asarray(mat)
@@ -244,10 +300,19 @@ def sharded_threshold_pairs(
     def slice_rows(arrs, r0):
         return jax.lax.dynamic_slice_in_dim(arrs[0], r0, row_tile, axis=0)
 
+    if use_pallas:
+        from galah_tpu.ops.pallas_pairwise import tile_stats_pallas
+
+        def stats_fn(rows, cols):
+            return tile_stats_pallas(rows, cols, sketch_size)
+    else:
+        def stats_fn(rows, cols):
+            return tile_stats(rows, cols, sketch_size, k)
+
     def compute_tile(arrs, rows, gt):
         cols = jax.lax.dynamic_slice_in_dim(
             arrs[0], gt * col_tile, col_tile, axis=0)
-        c, t = tile_stats(rows, cols, sketch_size, k)
+        c, t = stats_fn(rows, cols)
         return c.astype(jnp.int32), t.astype(jnp.int32)
 
     def stripe_mask(stripes):
@@ -268,6 +333,74 @@ def sharded_threshold_pairs(
         ani = stats_to_ani_f64(common[keep], total[keep], k)
         for a, b, v in zip(gi.tolist(), gj.tolist(), ani.tolist()):
             out[(int(a), int(b))] = float(v)
+    return out
+
+
+def sharded_screen_pairs(
+    marker_mat: np.ndarray,
+    counts: np.ndarray,
+    c_floor: float,
+    mesh: Mesh,
+    row_tile: int = 64,
+    col_tile: int = 256,
+    cap_per_row: int = 256,
+) -> list:
+    """i<j pairs with marker containment >= c_floor, columns sharded over
+    the mesh — the multi-device twin of ops/pairwise.screen_pairs (the
+    same blocked extraction core, with the marker-intersection count as
+    the tile computation and min-count containment as the threshold)."""
+    import math
+
+    from galah_tpu.ops.constants import SENTINEL
+    from galah_tpu.ops.pairwise import tile_intersect_counts
+
+    n = marker_mat.shape[0]
+    n_dev = mesh.devices.size
+    quantum = math.lcm(n_dev * col_tile, row_tile)
+    n_pad = -(-n // quantum) * quantum
+    mat = np.full((n_pad, marker_mat.shape[1]), np.uint64(SENTINEL),
+                  dtype=np.uint64)
+    mat[:n] = marker_mat
+    cnt = np.zeros(n_pad, dtype=np.int32)
+    cnt[:n] = counts
+    jmat = jnp.asarray(mat)
+    jcnt = jnp.asarray(cnt)
+
+    c_floor_lo = c_floor * (1.0 - 1e-12) - 1e-300
+
+    def slice_rows(arrs, r0):
+        return (jax.lax.dynamic_slice_in_dim(arrs[0], r0, row_tile,
+                                             axis=0),
+                jax.lax.dynamic_slice_in_dim(arrs[1], r0, row_tile,
+                                             axis=0))
+
+    def compute_tile(arrs, rows_ctx, gt):
+        rows, rcnt = rows_ctx
+        cols = jax.lax.dynamic_slice_in_dim(
+            arrs[0], gt * col_tile, col_tile, axis=0)
+        ccnt = jax.lax.dynamic_slice_in_dim(
+            arrs[1], gt * col_tile, col_tile, axis=0)
+        inter = tile_intersect_counts(rows, cols).astype(jnp.int32)
+        denom = jnp.minimum(rcnt[:, None], ccnt[None, :]).astype(jnp.int32)
+        denom = jnp.broadcast_to(denom, inter.shape)
+        return inter, denom
+
+    def stripe_mask(stripes):
+        inter, denom = stripes
+        mask = (inter.astype(jnp.float64)
+                >= jnp.float64(c_floor_lo) * denom.astype(jnp.float64))
+        return mask & (inter > 0)
+
+    out: list = []
+    for gi, gj, (inter, denom) in _sharded_blocked_extract(
+            mesh, (jmat, jcnt), n, n_pad, row_tile, col_tile,
+            cap_per_row, slice_rows, compute_tile,
+            (jnp.int32, jnp.int32), stripe_mask):
+        inter = inter.astype(np.float64)
+        denom = denom.astype(np.float64)
+        keep = inter >= c_floor * denom
+        out.extend(zip(gi[keep].tolist(), gj[keep].tolist()))
+    out.sort()
     return out
 
 

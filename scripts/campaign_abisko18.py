@@ -1,0 +1,78 @@
+"""Accuracy campaign: all backend combos over all 18 abisko4 MAGs.
+
+Computes cluster compositions for every (precluster, cluster) method
+combo at 95% and 99% ANI over the full abisko4 fixture set (the
+reference's own tests use only 4-5 of these 18 MAGs), prints them, and
+reports cross-combo agreement. Used once to derive the goldens pinned in
+tests/test_campaign_abisko18.py; rerun after kernel changes to check for
+drift.
+
+Run on CPU mesh (default, deterministic) or TPU:
+    python scripts/campaign_abisko18.py [--tpu]
+"""
+
+import glob
+import json
+import sys
+import time
+
+if "--tpu" not in sys.argv:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from galah_tpu.api import generate_galah_clusterer  # noqa: E402
+
+DATA = "/root/reference/tests/data/abisko4"
+
+COMBOS = [
+    ("finch", "skani"),
+    ("finch", "fastani"),
+    ("skani", "skani"),
+    ("dashing", "skani"),
+]
+
+
+def run(paths, pre, cl, ani):
+    values = {
+        "ani": ani, "precluster_ani": 90.0,
+        "min_aligned_fraction": 15.0, "fragment_length": 3000,
+        "precluster_method": pre, "cluster_method": cl, "threads": 1,
+        "checkm_tab_table": f"{DATA}/abisko4.csv",
+        "quality_formula": "Parks2020_reduced",
+    }
+    clusterer = generate_galah_clusterer(list(paths), values)
+    clusters = clusterer.cluster()
+    names = [p.rsplit("/", 1)[1] for p in clusterer.genome_paths]
+    return sorted(
+        sorted(names[i] for i in cluster) for cluster in clusters)
+
+
+def main():
+    paths = sorted(glob.glob(f"{DATA}/*.fna"))
+    assert len(paths) == 18, paths
+    results = {}
+    for ani in (95.0, 99.0):
+        for pre, cl in COMBOS:
+            t0 = time.perf_counter()
+            comp = run(paths, pre, cl, ani)
+            dt = time.perf_counter() - t0
+            key = f"{pre}+{cl}@{ani:.0f}"
+            results[key] = comp
+            print(f"## {key}  ({dt:.1f}s, {len(comp)} clusters)")
+            print(json.dumps(comp))
+    # cross-combo agreement per threshold
+    for ani in (95.0, 99.0):
+        keys = [f"{p}+{c}@{ani:.0f}" for p, c in COMBOS]
+        base = results[keys[0]]
+        agree = [k for k in keys if results[k] == base]
+        print(f"@{ani:.0f}: {len(agree)}/{len(keys)} combos agree "
+              f"with {keys[0]}")
+
+
+if __name__ == "__main__":
+    main()

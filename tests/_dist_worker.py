@@ -1,0 +1,70 @@
+"""Worker for the two-process jax.distributed smoke test.
+
+Launched by tests/test_multiprocess.py: each process owns 4 virtual CPU
+devices (8 global), ingests its strided host_shard of a deterministic
+sketch matrix, assembles the global row-sharded array, and runs the
+sharded pair count over the global mesh. Usage:
+
+    python tests/_dist_worker.py <coordinator> <num_procs> <proc_id>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    coord, n_proc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from galah_tpu.parallel import distributed
+    from galah_tpu.parallel.mesh import sharded_pair_count
+
+    distributed.initialize(coordinator_address=coord,
+                           num_processes=n_proc, process_id=pid)
+    assert distributed.process_count() == n_proc
+    assert jax.device_count() == 4 * n_proc
+
+    # Deterministic global sketch set with planted duplicate rows.
+    global_n, width = 16, 64
+    rng = np.random.default_rng(0)
+    mat = rng.integers(0, 1 << 63, size=(global_n, width),
+                       dtype=np.uint64)
+    mat.sort(axis=1)
+    mat[9] = mat[2]
+    mat[13] = mat[5]
+
+    # Each host "ingests" only its strided shard, as production would.
+    mine = np.array(distributed.host_shard(list(range(global_n))))
+    local_rows = mat[mine]
+
+    mesh = distributed.global_mesh()
+    garr = distributed.global_sketch_matrix(local_rows, global_n, mesh)
+
+    # The assembled array must equal the contiguous global matrix:
+    # every addressable shard is checked against its global rows.
+    for shard in garr.addressable_shards:
+        r0 = shard.index[0].start or 0
+        np.testing.assert_array_equal(
+            np.asarray(shard.data),
+            mat[r0:r0 + shard.data.shape[0]])
+
+    count = sharded_pair_count(mat, k=21, min_ani=0.99, mesh=mesh,
+                               col_tile=8)
+    print(f"COUNT {pid} {count}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -225,3 +225,59 @@ def test_missing_quality_entry_clean_error(tmp_path):
         "--quality-formula", "completeness-4contamination",
     ])
     assert rc == 1
+
+
+def _write_fraglen_pair(tmp_path):
+    """Synthetic pair whose clustering flips with --fragment-length.
+
+    Port of the reference's disabled fraglen test
+    (reference: tests/test_cmdline.rs:340-382 — commented out there, so
+    its exact fixture outcomes are not a pinned contract): homology
+    interleaved at sub-fragment scale (3000 bp homologous + 1500 bp
+    random per 4500 bp period). At --fragment-length 3000 every window
+    overlaps homology (aligned fraction 1.0 -> merges at 95% ANI); at
+    1000 the random stretches resolve (aligned fraction ~0.78, gated
+    out by --min-aligned-fraction 80 -> two clusters).
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    L = 60_000
+    base = rng.integers(0, 4, size=L)
+    query = base.copy()
+    period, rnd_len = 4500, 1500
+    for start in range(0, L, period):
+        s = start + period - rnd_len
+        e = min(start + period, L)
+        if s < L:
+            query[s:e] = rng.integers(0, 4, size=e - s)
+    alphabet = np.frombuffer(b"ACGT", dtype=np.uint8)
+    paths = []
+    for name, seq in (("seq_a.fna", base), ("seq_b.fna", query)):
+        p = tmp_path / name
+        with open(p, "wb") as fh:
+            fh.write(b">" + name.encode() + b"\n")
+            fh.write(alphabet[seq].tobytes() + b"\n")
+        paths.append(str(p))
+    return paths
+
+
+def test_fraglen_flag_flips_clustering(tmp_path):
+    a, b = _write_fraglen_pair(tmp_path)
+    common = [
+        "cluster", "--genome-fasta-files", a, b,
+        "--precluster-method", "finch", "--cluster-method", "fastani",
+        "--ani", "95", "--min-aligned-fraction", "80",
+    ]
+
+    reps_default = tmp_path / "reps_default.txt"
+    rc = _run(common + ["--output-representative-list",
+                        str(reps_default)])
+    assert rc == 0
+    assert reps_default.read_text() == f"{a}\n"  # merged: one rep
+
+    reps_1000 = tmp_path / "reps_1000.txt"
+    rc = _run(common + ["--fragment-length", "1000",
+                        "--output-representative-list", str(reps_1000)])
+    assert rc == 0
+    assert reps_1000.read_text() == f"{a}\n{b}\n"  # gated: two reps

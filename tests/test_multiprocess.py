@@ -1,0 +1,79 @@
+"""Two-process jax.distributed smoke test (real multi-process, CPU).
+
+Round-1 review finding: the jax.distributed init path had never executed
+with num_processes > 1. Here two actual OS processes rendezvous through
+a local coordinator, each owning 4 virtual CPU devices (8 global),
+assemble the globally-sharded sketch matrix from per-host strided
+shards, and run the sharded pair count — whose result must match the
+single-process value. This is the DCN scale-out path of SURVEY.md §5
+exercised for real (reference analog: none — the reference is strictly
+single-process, SURVEY.md §2.3).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_dist_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _expected_count() -> int:
+    """Single-process reference for the worker's planted matrix."""
+    from galah_tpu.ops.pairwise import threshold_pairs
+    from galah_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(0)
+    mat = rng.integers(0, 1 << 63, size=(16, 64), dtype=np.uint64)
+    mat.sort(axis=1)
+    mat[9] = mat[2]
+    mat[13] = mat[5]
+    pairs = threshold_pairs(mat, k=21, min_ani=0.99, row_tile=8,
+                            col_tile=8, mesh=make_mesh(1))
+    assert (2, 9) in pairs and (5, 13) in pairs
+    return len(pairs)
+
+
+def test_two_process_distributed_pair_count():
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coord, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=REPO)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, (
+                f"worker failed rc={p.returncode}\nstdout:{out}\n"
+                f"stderr:{err[-2000:]}")
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    counts = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("COUNT"):
+                _, pid, count = line.split()
+                counts[int(pid)] = int(count)
+    assert set(counts) == {0, 1}, f"missing worker output: {outs}"
+    expected = _expected_count()
+    assert counts[0] == counts[1] == expected

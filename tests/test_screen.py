@@ -1,0 +1,113 @@
+"""Blocked marker-containment screening (ops/pairwise.screen_pairs).
+
+The skani-equivalent candidate screen (reference: src/skani.rs:54-70)
+must (a) match a straightforward numpy reference on containment
+semantics, (b) agree between the single-device and column-sharded
+implementations, and (c) issue ONE device dispatch per row block —
+the O((N/tile)^2)-dispatch host loop it replaced is the pattern the
+round-1 review flagged as latency-bound.
+"""
+
+import numpy as np
+import pytest
+
+from galah_tpu.ops.constants import SENTINEL
+from galah_tpu.ops import pairwise
+from galah_tpu.parallel import make_mesh
+from galah_tpu.parallel.mesh import sharded_screen_pairs
+
+
+def _marker_fixture(n=50, m=128, seed=5):
+    """Random sorted marker rows with planted high-containment pairs."""
+    rng = np.random.default_rng(seed)
+    mat = np.full((n, m), np.uint64(SENTINEL), dtype=np.uint64)
+    counts = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        cnt = int(rng.integers(m // 2, m))
+        vals = rng.choice(1 << 20, size=cnt, replace=False).astype(
+            np.uint64) * 7919
+        mat[i, :cnt] = np.sort(vals)
+        counts[i] = cnt
+    # plant: 12 subset of 3 (full containment), 30 shares 90% with 8
+    sub = mat[3, :counts[3] // 2].copy()
+    mat[12] = np.uint64(SENTINEL)
+    mat[12, :sub.shape[0]] = sub
+    counts[12] = sub.shape[0]
+    take = int(counts[8] * 0.9)
+    shared = mat[8, :take]
+    extra = (np.arange(counts[30] - take, dtype=np.uint64) * 7919 + 3)
+    row = np.sort(np.concatenate([shared, extra]))
+    mat[30] = np.uint64(SENTINEL)
+    mat[30, :row.shape[0]] = row
+    return mat, counts
+
+
+def _numpy_screen(mat, counts, c_floor):
+    n = mat.shape[0]
+    out = []
+    for i in range(n):
+        a = mat[i, :counts[i]]
+        for j in range(i + 1, n):
+            b = mat[j, :counts[j]]
+            inter = np.intersect1d(a, b).shape[0]
+            denom = min(counts[i], counts[j])
+            if denom > 0 and inter >= c_floor * denom:
+                out.append((i, j))
+    return out
+
+
+@pytest.mark.parametrize("c_floor", [0.5, 0.8**15])
+def test_screen_pairs_matches_numpy(c_floor):
+    mat, counts = _marker_fixture()
+    got = pairwise.screen_pairs(mat, counts, c_floor, row_tile=16,
+                                col_tile=32, mesh=make_mesh(1))
+    assert got == _numpy_screen(mat, counts, c_floor)
+    assert (3, 12) in got  # planted full-containment pair
+
+
+def test_sharded_screen_pairs_matches_single_device():
+    mat, counts = _marker_fixture(n=70, seed=9)
+    c_floor = 0.6
+    ref = pairwise.screen_pairs(mat, counts, c_floor, row_tile=16,
+                                col_tile=32, mesh=make_mesh(1))
+    got = sharded_screen_pairs(mat, counts, c_floor, mesh=make_mesh(8),
+                               row_tile=16, col_tile=32)
+    assert got == ref
+
+
+def test_screen_dispatch_count_scales_with_row_blocks(monkeypatch):
+    """One device dispatch per row block: N=128 rows at row_tile=32 must
+    issue exactly 4 dispatches (not the 16+ a per-tile loop would)."""
+    calls = []
+    real = pairwise._rowblock_screen
+
+    def counting(*args, **kwargs):
+        calls.append(1)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(pairwise, "_rowblock_screen", counting)
+    mat, counts = _marker_fixture(n=128, seed=2)
+    pairwise.screen_pairs(mat, counts, 0.8, row_tile=32, col_tile=32,
+                          mesh=make_mesh(1))
+    assert len(calls) == 128 // 32
+
+
+def test_skani_preclusterer_uses_blocked_screen(ref_data):
+    """The backend end-to-end: screening via the blocked path still finds
+    the known closely-related abisko4 MAG pairs."""
+    from galah_tpu.backends.fragment_backend import SkaniPreclusterer
+
+    paths = [
+        str(ref_data / "abisko4" / n) for n in (
+            "73.20120800_S1X.13.fna",
+            "73.20120600_S2D.19.fna",
+            "73.20120700_S3X.12.fna",
+            "73.20110800_S2D.13.fna",
+        )
+    ]
+    pre = SkaniPreclusterer(threshold=0.95, min_aligned_fraction=0.15)
+    cache = pre.distances(paths)
+    # the 95%-ANI golden cluster [[0,1,3],[2]] implies 0-1, 0-3, 1-3 hits
+    assert cache.contains((0, 1))
+    assert cache.contains((0, 3))
+    assert cache.contains((1, 3))

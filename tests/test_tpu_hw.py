@@ -1,0 +1,83 @@
+"""Real-TPU (Mosaic, non-interpret) kernel validation.
+
+The CPU test mesh (conftest.py) can only exercise Pallas kernels in
+interpreter mode; round-1 review correctly flagged that interpret-mode
+parity says nothing about whether the kernels LOWER on hardware. This
+module spawns a subprocess WITHOUT the forced-CPU environment: if a TPU
+backend comes up there, the Mosaic-compiled kernels must match the XLA
+reference paths bit-for-bit; if no TPU is reachable the test skips.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_TPU_CODE = r"""
+import numpy as np
+import jax, jax.numpy as jnp
+
+if jax.default_backend() != "tpu":
+    print("NOTPU")
+    raise SystemExit(0)
+
+from galah_tpu.ops.pairwise import threshold_pairs, tile_stats
+from galah_tpu.ops.pallas_pairwise import tile_stats_pallas
+from galah_tpu.ops import hll
+from galah_tpu.ops.constants import SENTINEL
+
+rng = np.random.default_rng(3)
+K = 1000
+mat = rng.integers(0, 1 << 63, size=(64, K), dtype=np.uint64)
+for i in range(64):
+    cut = rng.integers(K // 2, K + 1)
+    mat[i, cut:] = np.uint64(SENTINEL)
+mat.sort(axis=1)
+mat[10] = mat[4]
+mat[33, :600] = mat[7, :600]
+mat.sort(axis=1)
+
+rows = jnp.asarray(mat[:32])
+cols = jnp.asarray(mat[32:])
+c_p, t_p = tile_stats_pallas(rows, cols, K)       # Mosaic compile
+c_x, t_x = tile_stats(rows, cols, K, 21)
+assert np.array_equal(np.asarray(c_p), np.asarray(c_x)), "common mismatch"
+assert np.array_equal(np.asarray(t_p), np.asarray(t_x)), "total mismatch"
+
+# end-to-end sparse extraction: auto path (pallas) vs pinned XLA
+auto = threshold_pairs(mat, k=21, min_ani=0.9)
+via_xla = threshold_pairs(mat, k=21, min_ani=0.9, use_pallas=False)
+assert auto == via_xla, f"{len(auto)} vs {len(via_xla)} pairs"
+assert (4, 10) in auto
+
+# HLL Mosaic kernel against the XLA union stats
+regs = rng.integers(0, 20, size=(32, 4096)).astype(np.uint8)
+pr = jnp.asarray(np.exp2(-regs.astype(np.float32)))
+from galah_tpu.ops.pallas_hll import hll_union_stats_tile
+ps_p, z_p = hll_union_stats_tile(pr, pr, chunk=1024)
+ps_x, z_x = hll._xla_union_stats(pr, pr)
+assert np.allclose(np.asarray(ps_p), np.asarray(ps_x), rtol=1e-5)
+assert np.array_equal(np.asarray(z_p), np.asarray(z_x))
+print("TPUOK")
+"""
+
+
+def test_mosaic_kernels_on_tpu_hardware():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _TPU_CODE], capture_output=True,
+            text=True, timeout=600, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU backend probe timed out (tunnel down?)")
+    if "NOTPU" in proc.stdout:
+        pytest.skip("no TPU backend available")
+    assert proc.returncode == 0, (
+        f"TPU kernel validation failed rc={proc.returncode}\n"
+        f"stdout:{proc.stdout}\nstderr:{proc.stderr[-3000:]}")
+    assert "TPUOK" in proc.stdout
