@@ -163,7 +163,7 @@ def pick_n(budget_s=25.0, n_max=8192):
     return n
 
 
-def bench_sketching():
+def bench_sketching(algo="murmur3"):
     """MinHash sketching throughput on real FASTA bytes, bp/s."""
     import glob
 
@@ -176,12 +176,17 @@ def bench_sketching():
         return None
     genomes = [read_genome(p) for p in paths]
     total_bp = sum(int(g.codes.shape[0]) for g in genomes)
-    sketch_genome_device(genomes[0], sketch_size=SKETCH_SIZE, k=K,
-                         seed=0)  # compile
+    for g in genomes:  # compile every chunk-bucket variant
+        sketch_genome_device(g, sketch_size=SKETCH_SIZE, k=K, seed=0,
+                             algo=algo)
     t0 = time.perf_counter()
+    acc = 0
     for g in genomes:
-        sketch_genome_device(g, sketch_size=SKETCH_SIZE, k=K, seed=0)
+        s = sketch_genome_device(g, sketch_size=SKETCH_SIZE, k=K,
+                                 seed=0, algo=algo)
+        acc += int(s.hashes[0]) & 0xFF  # force host materialization
     dt = time.perf_counter() - t0
+    assert acc >= 0
     return total_bp / dt
 
 
@@ -282,7 +287,8 @@ def main():
             if cpu_pps:
                 result["vs_baseline"] = round(result["value"] / cpu_pps, 2)
     except Exception as e:  # noqa: BLE001
-        errors.append(f"pairwise: {type(e).__name__}: {e}")
+        errors.append(
+            f"pairwise_pallas: {type(e).__name__}: {e}")
 
     # 4. The XLA searchsorted path on a smaller tile, for the record.
     try:
@@ -291,16 +297,19 @@ def main():
             stages["pairwise_xla_pairs_per_sec"] = round(
                 bench_extraction(mat, repeats=1, use_pallas=False), 1)
     except Exception as e:  # noqa: BLE001
-        errors.append(f"extraction: {type(e).__name__}: {e}")
+        errors.append(f"pairwise_xla: {type(e).__name__}: {e}")
 
-    # 5. Sketching throughput on real FASTA bytes.
-    try:
-        with watchdog(240):
-            bps = bench_sketching()
-            if bps:
-                stages["sketch_bp_per_sec"] = round(bps, 1)
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"sketching: {type(e).__name__}: {e}")
+    # 5. Sketching throughput on real FASTA bytes, both hash algos —
+    # each with its own watchdog so one failure never loses the other.
+    for algo, key in (("murmur3", "sketch_bp_per_sec"),
+                      ("tpufast", "sketch_tpufast_bp_per_sec")):
+        try:
+            with watchdog(240):
+                bps = bench_sketching(algo)
+                if bps:
+                    stages[key] = round(bps, 1)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"sketching-{algo}: {type(e).__name__}: {e}")
 
     # 6. End-to-end cluster() on planted families.
     try:

@@ -27,11 +27,13 @@ class HLLPreclusterer(PreclusterBackend):
     def __init__(self, min_ani: float, p: int = hll.DEFAULT_P,
                  k: int = Defaults.MINHASH_KMER,
                  seed: int = Defaults.MINHASH_SEED,
+                 hash_algo: str = Defaults.HASH_ALGO,
                  cache: "diskcache.CacheDir | None" = None) -> None:
         self.min_ani = float(min_ani)
         self.p = int(p)
         self.k = int(k)
         self.seed = int(seed)
+        self.algo = hash_algo
         self.cache = cache or diskcache.get_cache()
 
     def method_name(self) -> str:
@@ -44,7 +46,8 @@ class HLLPreclusterer(PreclusterBackend):
 
         n = len(genome_paths)
         logger.info("Sketching HLL registers of %d genomes on device ..", n)
-        params = {"p": self.p, "k": self.k, "seed": self.seed}
+        params = {"p": self.p, "k": self.k, "seed": self.seed,
+                  "algo": self.algo}
         regs = np.zeros((n, 1 << self.p), dtype=np.uint8)
         with timing.stage("sketch-hll"):
             from galah_tpu.io.prefetch import probe_and_prefetch
@@ -63,7 +66,8 @@ class HLLPreclusterer(PreclusterBackend):
                 regs[index[path]] = row
             for path, genome in miss_iter:
                 row = hll.hll_sketch_genome(
-                    genome, p=self.p, k=self.k, seed=self.seed)
+                    genome, p=self.p, k=self.k, seed=self.seed,
+                    algo=self.algo)
                 regs[index[path]] = row
                 self.cache.store(path, "hll", params, {"regs": row})
 

@@ -34,16 +34,18 @@ class SketchStore:
     """
 
     def __init__(self, sketch_size: int, k: int, seed: int = 0,
-                 cache: Optional["CacheDir"] = None) -> None:
+                 cache: Optional["CacheDir"] = None,
+                 algo: str = Defaults.HASH_ALGO) -> None:
         self.sketch_size = sketch_size
         self.k = k
         self.seed = seed
+        self.algo = algo
         self.cache = cache or diskcache.get_cache()
         self._sketches: Dict[str, MinHashSketch] = {}
 
     def _params(self) -> dict:
         return {"sketch_size": self.sketch_size, "k": self.k,
-                "seed": self.seed}
+                "seed": self.seed, "algo": self.algo}
 
     def get_cached(self, path: str) -> Optional[MinHashSketch]:
         """Sketch from memory or the disk cache only (no FASTA read)."""
@@ -62,7 +64,7 @@ class SketchStore:
         """Sketch an already-ingested genome and cache it."""
         s = sketch_genome_device(
             genome, sketch_size=self.sketch_size, k=self.k,
-            seed=self.seed)
+            seed=self.seed, algo=self.algo)
         self.cache.store(path, "minhash", self._params(),
                          {"hashes": s.hashes})
         self._sketches[path] = s
@@ -83,11 +85,13 @@ class MinHashPreclusterer(PreclusterBackend):
         k: int = Defaults.MINHASH_KMER,
         store: Optional[SketchStore] = None,
         cache: Optional[CacheDir] = None,
+        hash_algo: str = Defaults.HASH_ALGO,
     ) -> None:
         self.min_ani = float(min_ani)
         self.sketch_size = sketch_size
         self.k = k
-        self.store = store or SketchStore(sketch_size, k, cache=cache)
+        self.store = store or SketchStore(sketch_size, k, cache=cache,
+                                          algo=hash_algo)
 
     def method_name(self) -> str:
         return "finch"

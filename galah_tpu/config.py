@@ -27,6 +27,11 @@ class Defaults:
     MINHASH_KMER = 21
     MINHASH_SKETCH_SIZE = 1000
     MINHASH_SEED = 0
+    # Sketch hash: "murmur3" is bit-compatible with the reference's finch
+    # contract; "tpufast" is the multiply-free TPU-native mixer
+    # (statistically equivalent MinHash/HLL estimates, ~20x faster on the
+    # VPU, which has no fast integer multiply). --hash-algorithm.
+    HASH_ALGO = "murmur3"
 
     # FracMinHash (skani-equivalent) params (reference: src/skani.rs:131-163)
     SKANI_C = 125                    # FracMinHash compression factor
@@ -40,6 +45,7 @@ class Defaults:
 
 
 PRECLUSTER_METHODS = ("skani", "finch", "dashing")
+HASH_ALGORITHMS = ("murmur3", "tpufast")
 CLUSTER_METHODS = ("skani", "fastani")
 QUALITY_FORMULAS = (
     "Parks2020_reduced",

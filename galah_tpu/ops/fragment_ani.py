@@ -107,7 +107,7 @@ class GenomeProfile:
 
 
 def positional_hashes(genome: Genome, k: int,
-                      chunk: int = 1 << 20) -> np.ndarray:
+                      chunk: int = 1 << 23) -> np.ndarray:
     """All canonical k-mer hashes of a genome in genome order (device)."""
     n = genome.codes.shape[0]
     if n < k:

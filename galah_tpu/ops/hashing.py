@@ -102,43 +102,188 @@ def murmur3_x64_128_h1(keys: jax.Array, seed: int = 0) -> jax.Array:
     return h1
 
 
-@functools.partial(jax.jit, static_argnames=("k", "seed"))
+def _ascii64(c: jax.Array) -> jax.Array:
+    """2-bit code (uint8 vector) -> ACGT ASCII byte as uint64.
+
+    A select chain instead of a table gather: gathers are the scarce
+    resource on the VPU, selects are plain vector ops.
+    """
+    b = jnp.where(
+        c == jnp.uint8(0), jnp.uint8(65),
+        jnp.where(c == jnp.uint8(1), jnp.uint8(67),
+                  jnp.where(c == jnp.uint8(2), jnp.uint8(71),
+                            jnp.uint8(84))))
+    return b.astype(jnp.uint64)
+
+
+def _murmur3_k21_1d(cb, seed: int) -> jax.Array:
+    """murmur3 x64_128 h1 over 21-byte keys given as a list of 21 uint64
+    byte vectors — the 1-D twin of murmur3_x64_128_h1's (n, 21) path
+    (one 16-byte block + a 5-byte k1 tail), bit-identical."""
+    length = len(cb)
+    assert length == 21
+    n = cb[0].shape[0]
+    h1 = jnp.full((n,), jnp.uint64(seed))
+    h2 = jnp.full((n,), jnp.uint64(seed))
+
+    k1 = cb[0]
+    for b in range(1, 8):
+        k1 = k1 | (cb[b] << jnp.uint64(8 * b))
+    k2 = cb[8]
+    for b in range(1, 8):
+        k2 = k2 | (cb[8 + b] << jnp.uint64(8 * b))
+    k1 = _rotl64(k1 * _C1, 31) * _C2
+    h1 = h1 ^ k1
+    h1 = _rotl64(h1, 27) + h2
+    h1 = h1 * jnp.uint64(5) + jnp.uint64(0x52DCE729)
+    k2 = _rotl64(k2 * _C2, 33) * _C1
+    h2 = h2 ^ k2
+    h2 = _rotl64(h2, 31) + h1
+    h2 = h2 * jnp.uint64(5) + jnp.uint64(0x38495AB5)
+
+    k1 = cb[16]
+    for b in range(1, 5):
+        k1 = k1 | (cb[16 + b] << jnp.uint64(8 * b))
+    k1 = _rotl64(k1 * _C1, 31) * _C2
+    h1 = h1 ^ k1
+
+    h1 = h1 ^ jnp.uint64(length)
+    h2 = h2 ^ jnp.uint64(length)
+    h1 = h1 + h2
+    h2 = h2 + h1
+    h1 = _fmix64(h1)
+    h2 = _fmix64(h2)
+    h1 = h1 + h2
+    return h1
+
+
+def _tpufast_mix(x: jax.Array, seed: int) -> jax.Array:
+    """Multiply-free 64-bit mixer for TPU (shift-add sparse-constant
+    rounds).
+
+    The TPU VPU has no fast integer multiplier (a u64 multiply costs
+    ~50x a shift/xor under XLA's emulation), which makes MurmurHash3 —
+    12 u64 multiplies per k-mer — the sketching bottleneck. MinHash only
+    needs a UNIFORM ranking hash, not murmur parity, so this mixer
+    replaces every dense multiply with a sparse-constant multiply
+    (x * (1 + 2^a + 2^b) = x + (x<<a) + (x<<b): two shifts + two adds)
+    interleaved with xorshifts. Avalanche quality is validated
+    empirically in tests/test_tpufast_hash.py (bit balance, sketch-level
+    Jaccard accuracy vs the murmur path).
+    """
+    x = x ^ jnp.uint64((seed * 0x9E3779B97F4A7C15 + 0x1B873593) % (1 << 64))
+    for sh_a, sh_b, sh_x in ((21, 37, 29), (13, 47, 31), (17, 41, 33)):
+        # x *= (1 + 2^a + 2^b); x ^= x >> c  — wrap-around adds mix the
+        # low bits upward, the xorshift folds high entropy back down.
+        x = x + (x << jnp.uint64(sh_a)) + (x << jnp.uint64(sh_b))
+        x = x ^ (x >> jnp.uint64(sh_x))
+    x = x + (x << jnp.uint64(26))
+    x = x ^ (x >> jnp.uint64(32))
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("k", "seed", "algo"))
 def canonical_kmer_hashes_chunk(
     codes: jax.Array,       # uint8 (C,), 0-3 valid, 255 ambiguous/pad
-    boundary: jax.Array,    # int32 (C,), contig id per position
+    offsets: jax.Array,     # int32 (B,) contig start offsets (padded with
+                            # a value > any position; see iter_chunk_hashes)
+    pos: jax.Array,         # int32 scalar: global position of codes[0]
     k: int = 21,
     seed: int = 0,
+    algo: str = "murmur3",
 ) -> jax.Array:
     """Hash every canonical k-mer starting in this chunk -> (C-k+1,) uint64.
 
     Positions whose window contains an ambiguous base or crosses a contig
     boundary produce HASH_SENTINEL. The caller overlaps consecutive chunks
-    by k-1 positions so every k-mer is seen exactly once.
+    by k-1 positions so every k-mer is seen exactly once. The contig id
+    per position is derived ON DEVICE from the (tiny) offsets array —
+    uploading a per-position boundary array would quadruple the
+    host->device traffic of the 1-byte codes.
+
+    Everything is formulated over 1-D shifted slices of `codes` (k static
+    slices, fused elementwise chains) — the earlier (n_win, k) 2-D
+    formulation materialized hundreds of MB of uint64 intermediates per
+    chunk and was HBM-bound.
+
+    `algo` selects the hash: "murmur3" reproduces the reference's finch
+    contract bit-for-bit (canonical ASCII k-mer, murmur3 x64_128 h1,
+    reference: src/finch.rs:33-47; the golden 0.9808188 depends on it);
+    "tpufast" hashes the canonical 2-bit packed k-mer with a
+    multiply-free mixer — statistically equivalent MinHash estimates at
+    ~20x the device throughput (the VPU has no fast integer multiply).
     """
-    n_win = codes.shape[0] - k + 1
-    # (n_win, k) windows via k static slices — XLA fuses these gathers.
-    win = jnp.stack([codes[i:i + n_win] for i in range(k)], axis=1)
-    valid = jnp.all(win != jnp.uint8(255), axis=1)
+    n = codes.shape[0]
+    n_win = n - k + 1
+
+    # Per-position sanitized codes (255 -> 0): windows containing any
+    # ambiguous base are masked to SENTINEL at the end, so their hash
+    # inputs are irrelevant; valid windows see their exact bases.
+    cs = jnp.where(codes == jnp.uint8(255), jnp.uint8(0), codes)
+
+    # Sliding-window packs via log-doubling: pack(i, 2m) =
+    # pack(i, m) << 2m | pack(i+m, m), so k-wide window packs (and the
+    # window validity ANDs) cost O(log k) combines over 1-D arrays
+    # instead of O(k) shift-or chains.
+    w = {1: cs.astype(jnp.uint64)}                  # fwd pack, MSB-first
+    r = {1: (jnp.uint8(3) - cs).astype(jnp.uint64)}  # revcomp pack
+    v = {1: codes != jnp.uint8(255)}
+    m = 1
+    while 2 * m <= k:
+        lm = n - 2 * m + 1
+        w[2 * m] = (w[m][:lm] << jnp.uint64(2 * m)) | w[m][m:m + lm]
+        r[2 * m] = r[m][:lm] | (r[m][m:m + lm] << jnp.uint64(2 * m))
+        v[2 * m] = v[m][:lm] & v[m][m:m + lm]
+        m *= 2
+
+    # Combine the binary decomposition of k (most-significant first).
+    parts = [p for p in sorted(w, reverse=True) if k & p]
+    fwd = w[parts[0]][:n_win]
+    rev = r[parts[0]][:n_win]
+    valid = v[parts[0]][:n_win]
+    off = parts[0]
+    for p in parts[1:]:
+        fwd = (fwd << jnp.uint64(2 * p)) | w[p][off:off + n_win]
+        rev = rev | (r[p][off:off + n_win] << jnp.uint64(2 * off))
+        valid = valid & v[p][off:off + n_win]
+        off += p
+
+    gpos = pos + jnp.arange(n, dtype=jnp.int32)
+    boundary = jnp.searchsorted(offsets, gpos, side="right")
     valid = valid & (boundary[:n_win] == boundary[k - 1:k - 1 + n_win])
 
-    # Pack forward / reverse-complement for the lexicographic-min compare
-    # (code order A<C<G<T matches ASCII order, so integer compare == string
-    # compare at fixed length).
-    shifts = jnp.uint64(2) * jnp.arange(k - 1, -1, -1, dtype=jnp.uint64)
-    safe = jnp.where(valid[:, None], win, jnp.uint8(0))
-    w64 = safe.astype(jnp.uint64)
-    fwd = jnp.sum(w64 << shifts, axis=1, dtype=jnp.uint64)
-    rc = (jnp.uint8(3) - safe)[:, ::-1]
-    rev = jnp.sum(rc.astype(jnp.uint64) << shifts, axis=1, dtype=jnp.uint64)
+    # Lexicographic-min canonical compare: code order A<C<G<T matches
+    # ASCII order, so integer compare == string compare at fixed length
+    # (k <= 32 bases in 64 bits).
     use_fwd = fwd <= rev
 
-    canon = jnp.where(use_fwd[:, None], safe, rc)
-    ascii_kmers = _ASCII[canon]
-    hashes = murmur3_x64_128_h1(ascii_kmers, seed=seed)
+    if algo == "tpufast":
+        # the canonical 2-bit packed key is already in hand — no ASCII
+        # expansion, no murmur: just the multiply-free mixer
+        hashes = _tpufast_mix(jnp.where(use_fwd, fwd, rev), seed)
+    elif algo == "murmur3":
+        # canonical ASCII byte j: fwd ? ascii(cs[j]) : ascii(3-cs[k-1-j]).
+        # The select chains run ONCE over the full chunk; the per-byte
+        # views below are slices of those two arrays.
+        af = _ascii64(cs)
+        ar = _ascii64(jnp.uint8(3) - cs)
+        cb = [
+            jnp.where(use_fwd, af[j:j + n_win],
+                      ar[k - 1 - j:k - 1 - j + n_win])
+            for j in range(k)
+        ]
+        if k == 21:
+            hashes = _murmur3_k21_1d(cb, seed)
+        else:
+            ascii_kmers = jnp.stack(cb, axis=1).astype(jnp.uint8)
+            hashes = murmur3_x64_128_h1(ascii_kmers, seed=seed)
+    else:
+        raise ValueError(f"unknown hash algorithm {algo!r}")
     return jnp.where(valid, hashes, HASH_SENTINEL)
 
 
-def iter_chunk_hashes(codes, contig_offsets, k: int, chunk: int, seed: int = 0):
+def iter_chunk_hashes(codes, contig_offsets, k: int, chunk: int,
+                      seed: int = 0, algo: str = "murmur3"):
     """Yield (hashes, n_new) device arrays over fixed-size overlapping chunks.
 
     Single implementation of the chunk/pad/overlap discipline shared by the
@@ -151,10 +296,23 @@ def iter_chunk_hashes(codes, contig_offsets, k: int, chunk: int, seed: int = 0):
     if chunk <= k - 1:
         raise ValueError(f"chunk ({chunk}) must exceed k-1 ({k - 1})")
     n = codes.shape[0]
-    boundary = np.zeros(n, dtype=np.int32)
-    if contig_offsets.shape[0] > 2:
-        boundary = np.searchsorted(
-            contig_offsets, np.arange(n), side="right").astype(np.int32)
+
+    # Bucket the chunk size to the genome: padding a 2 Mbp genome into an
+    # 8 Mi chunk would upload 4x the bytes for nothing. Buckets are 64 Ki
+    # multiples so XLA compiles a handful of variants.
+    quantum = 1 << 16
+    chunk = max(quantum, min(chunk, -(-n // quantum) * quantum))
+
+    # Contig offsets, padded to a power-of-two length (bounding compile
+    # variants) with a sentinel beyond any real position so the padded
+    # entries never split a window.
+    offs = np.asarray(contig_offsets[1:-1], dtype=np.int64)
+    b = 1
+    while b < max(offs.shape[0], 1):
+        b <<= 1
+    offs_pad = np.full(b, np.int64(2**31 - 1), dtype=np.int64)
+    offs_pad[: offs.shape[0]] = offs
+    joffs = jnp.asarray(offs_pad.astype(np.int32))
 
     step = chunk - (k - 1)
     pos = 0
@@ -162,11 +320,10 @@ def iter_chunk_hashes(codes, contig_offsets, k: int, chunk: int, seed: int = 0):
     while pos < total or pos == 0:
         end = min(pos + chunk, n)
         c = np.full(chunk, 255, dtype=np.uint8)
-        b = np.full(chunk, -1, dtype=np.int32)
         c[: end - pos] = codes[pos:end]
-        b[: end - pos] = boundary[pos:end]
         hashes = canonical_kmer_hashes_chunk(
-            jnp.asarray(c), jnp.asarray(b), k=k, seed=seed)
+            jnp.asarray(c), joffs, jnp.int32(pos), k=k, seed=seed,
+            algo=algo)
         n_new = min(total - pos, chunk - k + 1) if total else 0
         yield hashes, pos, n_new
         pos += step

@@ -66,13 +66,14 @@ def hll_sketch_genome(
     p: int = DEFAULT_P,
     k: int = 21,
     seed: int = 0,
-    chunk: int = 1 << 20,
+    chunk: int = 1 << 23,
+    algo: str = "murmur3",
 ) -> np.ndarray:
     """(2^p,) uint8 HLL registers over the genome's canonical k-mers."""
     regs = jnp.zeros((1 << p,), dtype=jnp.uint8)
     for hashes, _pos, _n_new in hashing.iter_chunk_hashes(
             genome.codes, genome.contig_offsets, k=k, chunk=chunk,
-            seed=seed):
+            seed=seed, algo=algo):
         regs = _hll_update(regs, hashes, p)
     return np.asarray(regs)
 

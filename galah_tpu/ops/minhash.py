@@ -20,9 +20,12 @@ from galah_tpu.ops import hashing
 from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.minhash_np import MinHashSketch
 
-# 1 Mi positions per chunk: multi-Mbp genomes take a handful of kernel
-# launches; the (C, k) window tensor is ~21 MiB uint8.
-DEFAULT_CHUNK = 1 << 20
+# 8 Mi positions per chunk (iter_chunk_hashes buckets it down to the
+# genome size in 64 Ki steps): one dispatch covers most MAGs — through a
+# remote-tunnel TPU the per-dispatch round trip dominates hashing
+# launches. The hash pipeline is 1-D shifted slices (ops/hashing.py),
+# so chunk memory is a few uint64 arrays of C elements.
+DEFAULT_CHUNK = 1 << 23
 
 
 def sketch_genome_device(
@@ -31,11 +34,13 @@ def sketch_genome_device(
     k: int = Defaults.MINHASH_KMER,
     seed: int = Defaults.MINHASH_SEED,
     chunk: int = DEFAULT_CHUNK,
+    algo: str = Defaults.HASH_ALGO,
 ) -> MinHashSketch:
     """Bottom-k distinct canonical k-mer sketch, computed on device."""
     running = jnp.full((sketch_size,), hashing.HASH_SENTINEL)
     for hashes, _pos, _n_new in hashing.iter_chunk_hashes(
-            genome.codes, genome.contig_offsets, k=k, chunk=chunk, seed=seed):
+            genome.codes, genome.contig_offsets, k=k, chunk=chunk,
+            seed=seed, algo=algo):
         running = hashing.bottom_k_update(
             running, hashes, sketch_size=sketch_size)
 

@@ -391,13 +391,16 @@ def threshold_pairs(
     k: int,
     min_ani: float,
     sketch_size: Optional[int] = None,
-    row_tile: int = 64,
-    col_tile: int = 128,
+    row_tile: Optional[int] = None,
+    col_tile: Optional[int] = None,
     use_pallas: Optional[bool] = None,
     cap_per_row: int = 64,
     mesh: "Optional[Mesh]" = None,
 ) -> dict[tuple[int, int], float]:
     """Sparse {(i, j): ani} for i<j pairs with ani >= min_ani.
+
+    row_tile/col_tile default per path (XLA: 64x128; Mosaic: 128x512);
+    explicit values are honored on every path, including the fallback.
 
     One device dispatch per ROW BLOCK (not per tile): the block's stats
     stripe is computed tile-by-tile on device (`lax.map`), thresholded
@@ -435,17 +438,18 @@ def threshold_pairs(
         from galah_tpu.ops.hll import use_pallas_default
 
         use_pallas = use_pallas_default()
-    if use_pallas:
-        # The Mosaic kernel's program covers 8 query rows x all columns
-        # of its call; wider column tiles amortize dispatch overhead
-        # (VMEM residency for the reference planes caps the width).
-        row_tile, col_tile = 128, 512
+    # Per-path tile defaults, honoring any explicit caller values: the
+    # Mosaic kernel's program covers 8 query rows x all columns of its
+    # call, so wider column tiles amortize dispatch overhead (VMEM
+    # residency for the reference planes caps the width).
+    rt = row_tile if row_tile is not None else (128 if use_pallas else 64)
+    ct = col_tile if col_tile is not None else (512 if use_pallas else 128)
 
     if sketch_size is None:
         sketch_size = sketch_mat.shape[1]
     try:
         return _threshold_pairs_single(
-            sketch_mat, k, min_ani, sketch_size, row_tile, col_tile,
+            sketch_mat, k, min_ani, sketch_size, rt, ct,
             bool(use_pallas), cap_per_row)
     except Exception:
         if not use_pallas:
@@ -458,7 +462,9 @@ def threshold_pairs(
             "Pallas pair-stats kernel unavailable; falling back to the "
             "XLA searchsorted path", exc_info=True)
         return _threshold_pairs_single(
-            sketch_mat, k, min_ani, sketch_size, 64, 128, False,
+            sketch_mat, k, min_ani, sketch_size,
+            row_tile if row_tile is not None else 64,
+            col_tile if col_tile is not None else 128, False,
             cap_per_row)
 
 

@@ -223,8 +223,8 @@ def sharded_threshold_pairs(
     min_ani: float,
     mesh: Mesh,
     sketch_size: Optional[int] = None,
-    row_tile: int = 64,
-    col_tile: int = 128,
+    row_tile: Optional[int] = None,
+    col_tile: Optional[int] = None,
     cap_per_row: int = 64,
     use_pallas: Optional[bool] = None,
 ) -> dict:
@@ -247,7 +247,9 @@ def sharded_threshold_pairs(
     if use_pallas:
         try:
             return _sharded_threshold_pairs_impl(
-                sketch_mat, k, min_ani, mesh, sketch_size, 128, 128,
+                sketch_mat, k, min_ani, mesh, sketch_size,
+                row_tile if row_tile is not None else 128,
+                col_tile if col_tile is not None else 128,
                 cap_per_row, True)
         except Exception:
             # A Mosaic lowering failure must not take down the
@@ -259,7 +261,9 @@ def sharded_threshold_pairs(
                 "Pallas pair-stats kernel unavailable on the sharded "
                 "path; falling back to XLA", exc_info=True)
     return _sharded_threshold_pairs_impl(
-        sketch_mat, k, min_ani, mesh, sketch_size, row_tile, col_tile,
+        sketch_mat, k, min_ani, mesh, sketch_size,
+        row_tile if row_tile is not None else 64,
+        col_tile if col_tile is not None else 128,
         cap_per_row, False)
 
 

@@ -13,8 +13,12 @@ Run on CPU mesh (default, deterministic) or TPU:
 
 import glob
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 if "--tpu" not in sys.argv:
     import os

@@ -1,0 +1,105 @@
+"""BASELINE.md measurement ladder: end-to-end cluster() wall-clock.
+
+Runs the first rungs of the BASELINE.json config ladder on the current
+backend (TPU via the default interpreter; CPU with --cpu) and prints a
+markdown table row per rung with stage breakdowns:
+
+  rung 1: the abisko4 fixture set (18 real MAGs, 29 MB) — full two-stage
+          pipeline, CheckM quality ordering;
+  rung 2: N synthetic genomes with planted family structure
+          (default 100; --n to scale), precluster+cluster at 95/90.
+
+Usage: python scripts/ladder_bench.py [--cpu] [--n 100] [--hash tpufast]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--n", type=int, default=100,
+                    help="rung-2 synthetic genome count")
+    ap.add_argument("--genome-len", type=int, default=500_000)
+    ap.add_argument("--hash", default="murmur3",
+                    choices=("murmur3", "tpufast"))
+    ap.add_argument("--skip-rung1", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("JAX_ENABLE_X64", "1")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    from galah_tpu.api import generate_galah_clusterer
+    from galah_tpu.utils import timing
+
+    backend = jax.default_backend()
+    results = []
+
+    def run(name, paths, values):
+        timing.reset()
+        t0 = time.perf_counter()
+        clusterer = generate_galah_clusterer(paths, values)
+        clusters = clusterer.cluster()
+        dt = time.perf_counter() - t0
+        stages = {name: round(secs, 2)
+                  for name, secs, _count in timing.GLOBAL.items()}
+        results.append({
+            "rung": name, "backend": backend, "n_genomes": len(paths),
+            "wall_s": round(dt, 2), "n_clusters": len(clusters),
+            "genomes_per_s": round(len(paths) / dt, 3),
+            "stages": stages,
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    base_values = {
+        "ani": 95.0, "precluster_ani": 90.0,
+        "min_aligned_fraction": 15.0, "fragment_length": 3000,
+        "precluster_method": "finch", "cluster_method": "skani",
+        "threads": 4, "hash_algorithm": args.hash,
+    }
+
+    if not args.skip_rung1:
+        DATA = "/root/reference/tests/data/abisko4"
+        paths = sorted(glob.glob(f"{DATA}/*.fna"))
+        values = dict(base_values)
+        values["checkm_tab_table"] = f"{DATA}/abisko4.csv"
+        values["quality_formula"] = "Parks2020_reduced"
+        run("rung1-abisko18", paths, values)
+
+    # rung 2: synthetic planted families
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    import importlib
+
+    bench = importlib.import_module("bench")
+    n_fam = max(args.n // 4, 1)
+    paths = bench._synth_families(
+        n_genomes=args.n, genome_len=args.genome_len,
+        n_families=n_fam, mut=0.03, seed=11)
+    run(f"rung2-synthetic-{args.n}", paths, dict(base_values))
+
+    print("\n| rung | backend | N | wall (s) | genomes/s | clusters |")
+    print("|---|---|---|---|---|---|")
+    for r in results:
+        print(f"| {r['rung']} | {r['backend']} | {r['n_genomes']} | "
+              f"{r['wall_s']} | {r['genomes_per_s']} | "
+              f"{r['n_clusters']} |")
+
+
+if __name__ == "__main__":
+    main()

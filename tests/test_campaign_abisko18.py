@@ -1,0 +1,90 @@
+"""Accuracy campaign goldens: the full 18-MAG abisko4 fixture set.
+
+The reference's own tests cover only 4-5 of the 18 abisko4 MAGs
+(reference: src/clusterer.rs:481-663); this campaign clusters ALL 18
+with every backend combo. Goldens derived 2026-07-29 via
+scripts/campaign_abisko18.py: all four combos (finch+skani,
+finch+fastani, skani+skani, dashing+skani) produced IDENTICAL
+compositions at both 95% and 99% ANI — pinned below.
+
+The default suite runs one combo per threshold (about 3 minutes each on
+the CPU mesh); set GALAH_RUN_CAMPAIGN=1 to run the full combo matrix.
+"""
+
+import glob
+import os
+
+import pytest
+
+DATA = "/root/reference/tests/data/abisko4"
+
+GOLDEN_95 = [sorted([
+    "73.20110600_S2D.10.fna", "73.20110600_S3M.17.fna",
+    "73.20110700_S2D.12.fna", "73.20110700_S2M.14.fna",
+    "73.20110800_S1D.9.fna", "73.20110800_S2D.13.fna",
+    "73.20110800_S2M.16.fna", "73.20110800_S3D.14.fna",
+    "73.20120600_E3D.30.fna", "73.20120600_S2D.19.fna",
+    "73.20120700_S1D.20.fna", "73.20120700_S1X.9.fna",
+    "73.20120700_S2X.9.fna", "73.20120700_S3D.12.fna",
+    "73.20120700_S3X.12.fna", "73.20120800_S1D.21.fna",
+    "73.20120800_S1X.13.fna", "73.20120800_S2X.9.fna",
+])]
+
+GOLDEN_99 = sorted([
+    sorted([
+        "73.20110600_S2D.10.fna", "73.20110700_S2D.12.fna",
+        "73.20110700_S2M.14.fna", "73.20110800_S2D.13.fna",
+        "73.20110800_S2M.16.fna", "73.20110800_S3D.14.fna",
+        "73.20120600_S2D.19.fna", "73.20120700_S1D.20.fna",
+        "73.20120800_S1D.21.fna", "73.20120800_S1X.13.fna",
+        "73.20120800_S2X.9.fna",
+    ]),
+    ["73.20110600_S3M.17.fna"],
+    sorted([
+        "73.20110800_S1D.9.fna", "73.20120700_S1X.9.fna",
+        "73.20120700_S2X.9.fna", "73.20120700_S3D.12.fna",
+        "73.20120700_S3X.12.fna",
+    ]),
+    ["73.20120600_E3D.30.fna"],
+])
+
+_FULL = os.environ.get("GALAH_RUN_CAMPAIGN") == "1"
+COMBOS_95 = ([("finch", "skani"), ("finch", "fastani"),
+              ("skani", "skani"), ("dashing", "skani")]
+             if _FULL else [("dashing", "skani")])
+COMBOS_99 = (COMBOS_95 if _FULL else [("finch", "skani")])
+
+
+def _run(paths, pre, cl, ani):
+    from galah_tpu.api import generate_galah_clusterer
+
+    values = {
+        "ani": ani, "precluster_ani": 90.0,
+        "min_aligned_fraction": 15.0, "fragment_length": 3000,
+        "precluster_method": pre, "cluster_method": cl, "threads": 1,
+        "checkm_tab_table": f"{DATA}/abisko4.csv",
+        "quality_formula": "Parks2020_reduced",
+    }
+    clusterer = generate_galah_clusterer(list(paths), values)
+    clusters = clusterer.cluster()
+    names = [p.rsplit("/", 1)[1] for p in clusterer.genome_paths]
+    return sorted(sorted(names[i] for i in cluster)
+                  for cluster in clusters)
+
+
+@pytest.fixture(scope="module")
+def mag_paths(ref_data):
+    paths = sorted(glob.glob(f"{DATA}/*.fna"))
+    if len(paths) != 18:
+        pytest.skip("abisko4 fixture incomplete")
+    return paths
+
+
+@pytest.mark.parametrize("pre,cl", COMBOS_95)
+def test_all18_at_95(mag_paths, pre, cl):
+    assert _run(mag_paths, pre, cl, 95.0) == GOLDEN_95
+
+
+@pytest.mark.parametrize("pre,cl", COMBOS_99)
+def test_all18_at_99(mag_paths, pre, cl):
+    assert _run(mag_paths, pre, cl, 99.0) == GOLDEN_99
