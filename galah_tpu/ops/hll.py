@@ -224,8 +224,6 @@ def hll_threshold_pairs(
     min+add reduction — the Pallas kernel (ops/pallas_hll.py) on TPU,
     an XLA broadcast-min elsewhere.
     """
-    import math
-
     # Auto-dispatch to the sharded SPMD implementation only when the
     # caller left BOTH knobs unset: an explicit use_pallas (or an
     # explicit mesh) pins the single-device implementation so kernel
@@ -248,11 +246,35 @@ def hll_threshold_pairs(
     if use_pallas is None:
         use_pallas = use_pallas_default()
     if use_pallas:
-        # The Mosaic kernel is compiled/validated at the 128x128 output
-        # tile geometry (square tiles keep the out block at the native
-        # (8,128)-register multiple); other shapes have hit remote-compile
-        # hangs on v5e. Pin the tiling on the pallas path.
-        row_tile = col_tile = 128
+        try:
+            # The Mosaic kernel is compiled/validated at the 128x128
+            # output tile geometry (square tiles keep the out block at
+            # the native (8,128)-register multiple); other shapes have
+            # hit remote-compile hangs on v5e.
+            return _hll_threshold_single(
+                regs_mat, k, min_ani, 128, 128, True, cap_per_row)
+        except Exception:
+            # A Mosaic lowering failure must never take down the
+            # default path (same fallback as threshold_pairs).
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "Pallas HLL kernel unavailable; falling back to the "
+                "XLA union-stats path", exc_info=True)
+    return _hll_threshold_single(
+        regs_mat, k, min_ani, row_tile, col_tile, False, cap_per_row)
+
+
+def _hll_threshold_single(
+    regs_mat: np.ndarray,
+    k: int,
+    min_ani: float,
+    row_tile: int,
+    col_tile: int,
+    use_pallas: bool,
+    cap_per_row: int,
+) -> dict[Tuple[int, int], float]:
+    import math
 
     n, m = regs_mat.shape
     quantum = math.lcm(row_tile, col_tile)

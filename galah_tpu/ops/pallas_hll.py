@@ -48,9 +48,12 @@ def _kernel(rows_ref, cols_ref, powsum_ref, zeros_ref):
         powsum_ref[pl.ds(r, 1), :] += jnp.sum(mn, axis=1)[None, :]
         zeros_ref[pl.ds(r, 1), :] += jnp.sum(
             (mn == 1.0).astype(jnp.float32), axis=1)[None, :]
-        return 0
+        return jnp.int32(0)
 
-    jax.lax.fori_loop(0, rows_ref.shape[0], body, 0)
+    # int32 bounds: under jax_enable_x64 a python-int fori_loop index
+    # becomes int64, which Mosaic cannot lower.
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(rows_ref.shape[0]), body,
+                      jnp.int32(0))
 
 
 @functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
@@ -72,19 +75,22 @@ def hll_union_stats_tile(
     if m % chunk:
         raise ValueError(f"register width {m} not a multiple of {chunk}")
     grid = (m // chunk,)
+    # index-map zeros are written as c*0 so they carry the grid index's
+    # own dtype: a literal 0 canonicalizes to int64 under x64, which
+    # Mosaic rejects at the MLIR boundary.
     return pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((br, chunk), lambda c: (0, c),
+            pl.BlockSpec((br, chunk), lambda c: (c * 0, c),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((bc, chunk), lambda c: (0, c),
+            pl.BlockSpec((bc, chunk), lambda c: (c * 0, c),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((br, bc), lambda c: (0, 0),
+            pl.BlockSpec((br, bc), lambda c: (c * 0, c * 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((br, bc), lambda c: (0, 0),
+            pl.BlockSpec((br, bc), lambda c: (c * 0, c * 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
