@@ -59,6 +59,7 @@ class ClustererCommandDefinition:
     cluster_method: str = "cluster-method"
     quality_formula: str = "quality-formula"
     hash_algorithm: str = "hash-algorithm"
+    ani_subsample: str = "ani-subsample"
     checkm_tab_table: str = "checkm-tab-table"
     checkm2_quality_report: str = "checkm2-quality-report"
     genome_info: str = "genome-info"
@@ -126,6 +127,15 @@ def add_cluster_arguments(
                              "compatible) or tpufast (multiply-free "
                              "TPU mixer, ~20x faster sketching; "
                              "default: murmur3)")
+    parser.add_argument(f"--{d.ani_subsample}", type=int,
+                        default=Defaults.ANI_SUBSAMPLE,
+                        help="FracMinHash compression of the exact "
+                             "fragment-ANI stage: keep only k-mers "
+                             "with hash < 2^64/c (1 = every k-mer, "
+                             "dense; skani's own compression is 125). "
+                             "Higher is ~c-fold faster with slightly "
+                             "noisier per-fragment identity "
+                             "(default: 1)")
     parser.add_argument(f"--{d.threads}", "-t", type=int, default=1,
                         help="Host threads for FASTA stats/IO fan-out; "
                              "device parallelism is managed by the mesh")
@@ -205,6 +215,13 @@ def generate_galah_clusterer(
         raise ValueError(
             f"unknown hash algorithm {hash_algo!r}; "
             f"choices: {HASH_ALGORITHMS}")
+    raw_subsample = _get(values, d, d.ani_subsample)
+    ani_subsample = int(raw_subsample if raw_subsample is not None
+                        else Defaults.ANI_SUBSAMPLE)
+    if not 1 <= ani_subsample <= 1000:
+        raise ValueError(
+            f"--{d.ani_subsample} must be in [1, 1000], "
+            f"got {ani_subsample}")
 
     # Quality filter + ordering
     quality_inputs = [
@@ -257,7 +274,8 @@ def generate_galah_clusterer(
     if pre_method == "skani" and cl_method == "skani":
         precluster_ani = ani
 
-    store = ProfileStore(fraglen=fraglen, cache=cache)
+    store = ProfileStore(fraglen=fraglen, cache=cache,
+                         subsample_c=ani_subsample)
     if pre_method == "finch":
         pre = MinHashPreclusterer(min_ani=precluster_ani, cache=cache,
                                   hash_algo=hash_algo)
@@ -290,7 +308,11 @@ def generate_galah_clusterer(
         "hll": {"p": DEFAULT_P, "k": Defaults.MINHASH_KMER, "seed": 0,
                 "algo": hash_algo},
         "fragment": {"k": ANI_KMER, "fraglen": fraglen,
-                     "screen_identity": SkaniPreclusterer.SCREEN_IDENTITY},
+                     "screen_identity": SkaniPreclusterer.SCREEN_IDENTITY,
+                     # only recorded when active so default-path
+                     # checkpoint fingerprints survive the upgrade
+                     **({"subsample_c": ani_subsample}
+                        if ani_subsample != 1 else {})},
     }
     return GalahClusterer(genome_paths=genome_paths, preclusterer=pre,
                           clusterer=cl, backend_params=backend_params)

@@ -60,16 +60,23 @@ class ProfileStore:
     def __init__(self, k: int = ANI_KMER,
                  fraglen: int = Defaults.FRAGMENT_LENGTH,
                  maxsize: int = 128,
-                 cache: Optional[diskcache.CacheDir] = None) -> None:
+                 cache: Optional[diskcache.CacheDir] = None,
+                 subsample_c: int = Defaults.ANI_SUBSAMPLE) -> None:
         self.k = k
         self.fraglen = fraglen
+        self.subsample_c = int(subsample_c)
         self.maxsize = maxsize
         self.disk = cache or diskcache.get_cache()
         self._cache: "collections.OrderedDict[str, GenomeProfile]" = (
             collections.OrderedDict())
 
     def _params(self) -> dict:
-        return {"k": self.k, "fraglen": self.fraglen}
+        p = {"k": self.k, "fraglen": self.fraglen}
+        # only key the cache on subsample_c when it is active, so
+        # default-path entries from before the flag existed stay valid
+        if self.subsample_c != 1:
+            p["subsample_c"] = self.subsample_c
+        return p
 
     @contextlib.contextmanager
     def reserve(self, n: int):
@@ -97,10 +104,12 @@ class ProfileStore:
             prof = GenomeProfile(
                 path=path, k=self.k, fraglen=self.fraglen,
                 flat_hashes=entry["flat_hashes"],
-                ref_set=entry["ref_set"], markers=entry["markers"])
+                ref_set=entry["ref_set"], markers=entry["markers"],
+                subsample_c=self.subsample_c)
         else:
             prof = fragment_ani.build_profile(
-                read_genome(path), k=self.k, fraglen=self.fraglen)
+                read_genome(path), k=self.k, fraglen=self.fraglen,
+                subsample_c=self.subsample_c)
             self.disk.store(path, "profile", self._params(), {
                 "flat_hashes": prof.flat_hashes,
                 "ref_set": prof.ref_set,

@@ -38,6 +38,11 @@ class Defaults:
     SKANI_MARKER_C = 1000            # marker sketch compression
     SKANI_KMER = 15
     SKANI_SCREEN_CONTAINMENT = 0.80  # candidate screening (src/skani.rs:59)
+    # FracMinHash subsampling of the exact fragment-ANI stage: 1 keeps
+    # every k-mer (dense; the pinned goldens/accuracy bounds use this);
+    # higher values trade a little per-window variance for ~c-fold less
+    # membership-test work (the reference's skani runs at c=125).
+    ANI_SUBSAMPLE = 1
 
     # Quality-filter defaults: no filtering unless quality input given
     MIN_COMPLETENESS = None

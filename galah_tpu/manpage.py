@@ -59,7 +59,8 @@ _SECTIONS: List[Tuple[str, str, List[str]]] = [
      "restricted to pairs that survived preclustering. Thresholds "
      "accept percentages (1-100) or fractions (0-1).",
      ["--ani", "--precluster-ani", "--min-aligned-fraction",
-      "--fragment-length", "--precluster-method", "--cluster-method"]),
+      "--fragment-length", "--precluster-method", "--cluster-method",
+      "--hash-algorithm", "--ani-subsample"]),
     ("QUALITY FILTERING AND RANKING",
      "When a quality table is provided, genomes are filtered by "
      "completeness/contamination and ranked by the quality formula; "

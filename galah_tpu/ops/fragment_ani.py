@@ -59,6 +59,11 @@ class GenomeProfile:
     flat_hashes: np.ndarray   # uint64 (n-k+1,), positional, SENTINEL-masked
     ref_set: np.ndarray       # uint64 sorted distinct hashes
     markers: np.ndarray       # uint64 sorted, hashes < 2^64 / MARKER_C
+    #: FracMinHash compression: only k-mers with hash < 2^64/c
+    #: participate in window counting and the reference set (the
+    #: reference's skani uses c=125 the same way, src/skani.rs:159-161);
+    #: c=1 keeps every k-mer (dense, exact)
+    subsample_c: int = 1
 
     # lazily cached device-resident padded views (upload once per genome)
     _dev_windows: Optional[jax.Array] = None
@@ -93,9 +98,17 @@ class GenomeProfile:
         return self._dev_ref_set
 
     def windows(self) -> np.ndarray:
-        """(W, fraglen) positional hash windows; k-mers crossing a window
+        """(W, slots) positional hash windows; k-mers crossing a window
         boundary are masked so each fragment is self-contained, matching
-        fastANI's disjoint 3 kb fragments."""
+        fastANI's disjoint 3 kb fragments.
+
+        With subsample_c > 1 the surviving (non-SENTINEL) hashes are
+        compacted to the front of each row and the row width shrinks to
+        the longest window's count (padded to a multiple of 64) — the
+        per-window (matched, total) integers are unchanged (counting is
+        SENTINEL-aware and order-independent), but the membership-test
+        work really does drop ~c-fold.
+        """
         L = self.fraglen
         flat = self.flat_hashes
         w = self.n_windows
@@ -103,6 +116,16 @@ class GenomeProfile:
         pad[: flat.shape[0]] = flat
         wins = pad.reshape(w, L).copy()
         wins[:, L - (self.k - 1):] = np.uint64(SENTINEL)
+        if self.subsample_c > 1:
+            # stable argsort of the sentinel mask moves surviving
+            # hashes to the front of each row, preserving their order
+            order = np.argsort(wins == np.uint64(SENTINEL), axis=1,
+                               kind="stable")
+            wins = np.take_along_axis(wins, order, axis=1)
+            counts = (wins != np.uint64(SENTINEL)).sum(axis=1)
+            slots = max(int(counts.max()) if counts.size else 1, 1)
+            slots = -(-slots // 64) * 64
+            wins = wins[:, :slots].copy()
         return wins
 
 
@@ -119,14 +142,35 @@ def positional_hashes(genome: Genome, k: int,
     return out
 
 
-def build_profile(genome: Genome, k: int, fraglen: int) -> GenomeProfile:
+def build_profile(genome: Genome, k: int, fraglen: int,
+                  subsample_c: int = 1) -> GenomeProfile:
+    """Profile a genome for fragment ANI.
+
+    With subsample_c > 1 only k-mers whose hash falls below 2^64/c are
+    kept (positionally SENTINEL-masked, so window structure survives):
+    a FracMinHash subsample, exactly the compression the reference's
+    skani applies with c=125 (reference: src/skani.rs:159-161). Both
+    the query windows AND the reference set shrink by ~c, cutting the
+    membership-test work ~c^2/c = c-fold per direction with an
+    unbiased per-window matched-fraction estimate. Markers are computed
+    from the full distinct set's sub-2^64/MARKER_C slice, which is a
+    subset of any c <= MARKER_C selection, so screening semantics are
+    unchanged.
+    """
+    if not 1 <= subsample_c <= MARKER_C:
+        raise ValueError(
+            f"subsample_c must be in [1, {MARKER_C}], got {subsample_c}")
     flat = positional_hashes(genome, k)
+    if subsample_c > 1:
+        cut = np.uint64((1 << 64) // subsample_c)
+        flat = np.where(flat < cut, flat, np.uint64(SENTINEL))
     valid = flat[flat != np.uint64(SENTINEL)]
     ref_set = np.unique(valid)
     markers = ref_set[ref_set < np.uint64((1 << 64) // MARKER_C)]
     return GenomeProfile(
         path=genome.path, k=k, fraglen=fraglen,
-        flat_hashes=flat, ref_set=ref_set, markers=markers)
+        flat_hashes=flat, ref_set=ref_set, markers=markers,
+        subsample_c=subsample_c)
 
 
 def _bucket_pow2(n: int, floor: int = 1 << 12) -> int:
@@ -216,7 +260,9 @@ def _directed_from_counts(
     matched = matched.astype(np.float64)
     total = total.astype(np.float64)
 
-    min_valid = min_window_valid_frac * (query.fraglen - k + 1)
+    # expected k-mer slots per window shrink by the FracMinHash factor
+    min_valid = (min_window_valid_frac * (query.fraglen - k + 1)
+                 / query.subsample_c)
     frag_ok = total >= max(min_valid, 1.0)
     with np.errstate(divide="ignore", invalid="ignore"):
         c_w = np.where(frag_ok, matched / np.maximum(total, 1.0), 0.0)
@@ -327,6 +373,16 @@ def _shard_batch(pairs: "list[Tuple[GenomeProfile, GenomeProfile]]",
     return wins, refs
 
 
+def _check_same_subsample(a: GenomeProfile, b: GenomeProfile) -> None:
+    """Profiles built at different FracMinHash cuts are incomparable —
+    a query filtered at one cut can never match a reference filtered at
+    another, silently collapsing ANI to nothing."""
+    if a.subsample_c != b.subsample_c:
+        raise ValueError(
+            f"GenomeProfiles built with different subsample_c "
+            f"({a.subsample_c} vs {b.subsample_c}) cannot be compared")
+
+
 def bidirectional_ani_batch(
     pairs: "list[Tuple[GenomeProfile, GenomeProfile]]",
     min_aligned_frac: float,
@@ -335,6 +391,8 @@ def bidirectional_ani_batch(
     """Batched twin of `bidirectional_ani`: both directions of every pair
     go through one `directed_ani_batch` call; the gate/max semantics per
     pair are identical to the scalar path."""
+    for a, b in pairs:
+        _check_same_subsample(a, b)
     directed = directed_ani_batch(
         [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs],
         identity_floor=identity_floor)
@@ -379,6 +437,7 @@ def bidirectional_ani(
     failed / nothing aligned) plus both directed results for callers that
     need them.
     """
+    _check_same_subsample(a, b)
     ab = directed_ani(a, b, identity_floor=identity_floor)
     ba = directed_ani(b, a, identity_floor=identity_floor)
     return _combine_bidirectional(ab, ba, min_aligned_frac), ab, ba

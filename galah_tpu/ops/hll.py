@@ -243,14 +243,21 @@ def hll_threshold_pairs(
             row_tile=row_tile, col_tile=col_tile,
             cap_per_row=cap_per_row)
 
+    # Fall back to XLA on Mosaic failure ONLY when pallas was chosen by
+    # default: an explicit use_pallas=True pins the kernel so parity
+    # tests fail loudly instead of vacuously comparing XLA to XLA.
+    explicit = use_pallas is not None
     if use_pallas is None:
         use_pallas = use_pallas_default()
     if use_pallas:
+        # The Mosaic kernel is compiled/validated at the 128x128 output
+        # tile geometry (square tiles keep the out block at the native
+        # (8,128)-register multiple); other shapes have hit
+        # remote-compile hangs on v5e.
+        if explicit:
+            return _hll_threshold_single(
+                regs_mat, k, min_ani, 128, 128, True, cap_per_row)
         try:
-            # The Mosaic kernel is compiled/validated at the 128x128
-            # output tile geometry (square tiles keep the out block at
-            # the native (8,128)-register multiple); other shapes have
-            # hit remote-compile hangs on v5e.
             return _hll_threshold_single(
                 regs_mat, k, min_ani, 128, 128, True, cap_per_row)
         except Exception:

@@ -434,6 +434,10 @@ def threshold_pairs(
             row_tile=row_tile, col_tile=col_tile,
             cap_per_row=cap_per_row, use_pallas=use_pallas)
 
+    # An explicit use_pallas=True pins the Mosaic kernel (failures
+    # propagate, keeping parity tests honest); only the default choice
+    # falls back to XLA on Mosaic failure.
+    explicit = use_pallas is not None
     if use_pallas is None:
         from galah_tpu.ops.hll import use_pallas_default
 
@@ -452,7 +456,7 @@ def threshold_pairs(
             sketch_mat, k, min_ani, sketch_size, rt, ct,
             bool(use_pallas), cap_per_row)
     except Exception:
-        if not use_pallas:
+        if not use_pallas or explicit:
             raise
         # The Mosaic kernel failing to lower (driver/toolchain drift)
         # must never take down the default path: fall back to XLA.

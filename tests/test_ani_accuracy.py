@@ -105,3 +105,55 @@ def test_unrelated_genomes_measure_no_ani():
         pa, pb, min_aligned_frac=0.15)
     assert ani is None
     assert ab.frags_matching == 0 and ba.frags_matching == 0
+
+
+@pytest.mark.parametrize("c", [16, 125])
+def test_subsampled_ani_tracks_planted_rate(c):
+    """FracMinHash subsampling (--ani-subsample) must keep the measured
+    ANI within 0.5pp of the planted rate — the accuracy class of the
+    reference's skani, which runs at c=125 (reference:
+    src/skani.rs:159-161)."""
+    rng = np.random.default_rng(c)
+    base = rng.integers(0, 4, size=L).astype(np.uint8)
+    mut, n_sites = _mutate(base, 0.03, rng)
+    planted = 1.0 - n_sites / L
+
+    pa = fragment_ani.build_profile(_genome(base, "a"), k=K,
+                                    fraglen=3000, subsample_c=c)
+    pb = fragment_ani.build_profile(_genome(mut, "b"), k=K,
+                                    fraglen=3000, subsample_c=c)
+    ani, ab, ba = fragment_ani.bidirectional_ani(
+        pa, pb, min_aligned_frac=0.15)
+    assert ani is not None
+    assert abs(ani - planted) < 0.005, (c, ani, planted)
+    assert ab.aligned_fraction > 0.9
+    # the subsampled reference set really is ~c-fold smaller
+    assert pa.ref_set.shape[0] < (L / c) * 1.3
+
+
+def test_subsampled_cli_keeps_golden_clusters(tmp_path):
+    """--ani-subsample 16 must reproduce the reference's 4-MAG golden
+    composition (clusters are robust to the per-window variance)."""
+    import pytest as _pytest
+
+    ref = "/root/reference/tests/data/abisko4"
+    import os
+    if not os.path.isdir(ref):
+        _pytest.skip("reference fixtures unavailable")
+    from galah_tpu.cli import main
+
+    paths = [f"{ref}/{m}" for m in (
+        "73.20120800_S1X.13.fna", "73.20120600_S2D.19.fna",
+        "73.20120700_S3X.12.fna", "73.20110800_S2D.13.fna")]
+    out = tmp_path / "c.tsv"
+    rc = main(["cluster", "--genome-fasta-files", *paths,
+               "--precluster-method", "finch", "--cluster-method",
+               "skani", "--ani", "99", "--ani-subsample", "16",
+               "--output-cluster-definition", str(out)])
+    assert rc == 0
+    clusters = {}
+    for line in out.read_text().splitlines():
+        rep, member = line.split("\t")
+        clusters.setdefault(rep, set()).add(paths.index(member))
+    got = sorted(clusters.values(), key=lambda s: -len(s))
+    assert got == [{0, 1, 3}, {2}]
