@@ -15,7 +15,8 @@ import sys
 
 import galah_tpu
 from galah_tpu.api import add_cluster_arguments, generate_galah_clusterer
-from galah_tpu.config import Defaults, parse_percentage
+from galah_tpu.config import (Defaults, HASH_ALGORITHMS,
+                              parse_percentage)
 from galah_tpu.utils import timing
 from galah_tpu.utils.logging import set_log_level
 
@@ -103,8 +104,86 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Length of fragment used in fastANI-style "
                         "calculation (default: 3000)")
     v.add_argument("--threads", "-t", type=int, default=1)
-    parser._subcommand_parsers = {"cluster": c, "cluster-validate": v}
+
+    dd = sub.add_parser(
+        "dist",
+        help="Calculate pairwise MinHash ANI between a set of genomes",
+        description="All-pairs sketch-based ANI as a TSV — the "
+                    "reference carries this subcommand disabled "
+                    "(reference: src/main.rs:88-114); here the pair "
+                    "matrix is one tiled device computation")
+    _add_verbosity(dd)
+    _add_genome_inputs(dd)
+    dd.add_argument("--num-hashes", type=int,
+                    default=Defaults.MINHASH_SKETCH_SIZE,
+                    help="MinHash sketch size (default: 1000)")
+    dd.add_argument("--kmer-length", type=int,
+                    default=Defaults.MINHASH_KMER,
+                    help="k-mer length (default: 21)")
+    dd.add_argument("--hash-algorithm", default=Defaults.HASH_ALGO,
+                    choices=HASH_ALGORITHMS,
+                    help="Sketch hash (default: murmur3)")
+    dd.add_argument("--min-ani", type=float, default=0.0,
+                    help="Only report pairs at or above this ANI "
+                         "(percent or fraction; default: report every "
+                         "pair with any sketch overlap)")
+    dd.add_argument("--output", help="Output TSV (default: stdout)")
+    dd.add_argument("--sketch-cache",
+                    help="Directory for the persistent sketch cache "
+                         "(also via GALAH_TPU_CACHE)")
+    dd.add_argument("--threads", "-t", type=int, default=1)
+    parser._subcommand_parsers = {"cluster": c, "cluster-validate": v,
+                                  "dist": dd}
     return parser
+
+
+def run_dist(args) -> int:
+    """All-pairs sketch ANI -> TSV of genome_a, genome_b, ani lines."""
+    import sys as _sys
+
+    from galah_tpu.backends.minhash_backend import SketchStore
+    from galah_tpu.genome_inputs import parse_genome_inputs
+    from galah_tpu.io import diskcache
+    from galah_tpu.ops.minhash import sketch_matrix
+    from galah_tpu.ops.pairwise import threshold_pairs
+
+    genomes = parse_genome_inputs(
+        genome_fasta_files=args.genome_fasta_files,
+        genome_fasta_list=args.genome_fasta_list,
+        genome_fasta_directory=args.genome_fasta_directory,
+        genome_fasta_extension=args.genome_fasta_extension,
+    )
+    cache = diskcache.get_cache(getattr(args, "sketch_cache", None))
+    store = SketchStore(args.num_hashes, args.kmer_length, cache=cache,
+                        algo=args.hash_algorithm)
+    logger.info("Sketching %d genomes ..", len(genomes))
+    # host threads prefetch FASTA ingestion while the device sketches
+    # (same idiom as MinHashPreclusterer.distances)
+    from galah_tpu.io.fasta import read_genome
+    from galah_tpu.io.prefetch import probe_and_prefetch
+
+    by_path, miss_iter = probe_and_prefetch(
+        genomes, store.get_cached, read_genome,
+        depth=max(2, getattr(args, "threads", 1)))
+    for p, genome in miss_iter:
+        by_path[p] = store.put_from_genome(p, genome)
+    mat = sketch_matrix([by_path[p] for p in genomes],
+                        sketch_size=args.num_hashes)
+    min_ani = (parse_percentage(args.min_ani, "--min-ani")
+               if args.min_ani else 0.0)
+    logger.info("Computing tiled all-pairs ANI ..")
+    pairs = threshold_pairs(mat, k=args.kmer_length, min_ani=min_ani,
+                            sketch_size=args.num_hashes)
+    out = open(args.output, "w") if args.output else _sys.stdout
+    try:
+        for (i, j) in sorted(pairs):
+            out.write(f"{genomes[i]}\t{genomes[j]}\t"
+                      f"{pairs[(i, j)]:.6f}\n")
+    finally:
+        if args.output:
+            out.close()
+    logger.info("Wrote %d pairs", len(pairs))
+    return 0
 
 
 def run_cluster(args) -> int:
@@ -215,6 +294,8 @@ def main(argv=None) -> int:
     try:
         if args.subcommand == "cluster":
             return run_cluster(args)
+        elif args.subcommand == "dist":
+            return run_dist(args)
         else:
             return run_cluster_validate(args)
     except (ValueError, OSError, KeyError) as e:

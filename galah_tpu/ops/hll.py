@@ -250,17 +250,18 @@ def hll_threshold_pairs(
     if use_pallas is None:
         use_pallas = use_pallas_default()
     if use_pallas:
-        # The Mosaic kernel is compiled/validated at the 128x128 output
-        # tile geometry (square tiles keep the out block at the native
-        # (8,128)-register multiple); other shapes have hit
-        # remote-compile hangs on v5e.
-        if explicit:
-            return _hll_threshold_single(
-                regs_mat, k, min_ani, 128, 128, True, cap_per_row)
         try:
+            # The Mosaic kernel is compiled/validated at the 128x128
+            # output tile geometry (square tiles keep the out block at
+            # the native (8,128)-register multiple); other shapes have
+            # hit remote-compile hangs on v5e.
             return _hll_threshold_single(
                 regs_mat, k, min_ani, 128, 128, True, cap_per_row)
         except Exception:
+            if explicit:
+                # an explicitly requested kernel fails loudly so parity
+                # tests can't vacuously compare XLA to XLA
+                raise
             # A Mosaic lowering failure must never take down the
             # default path (same fallback as threshold_pairs).
             import logging

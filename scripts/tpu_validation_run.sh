@@ -1,0 +1,32 @@
+#!/bin/bash
+# Opportunistic TPU validation: wait for a responsive tunnel, then run
+# the hardware kernel validation, the benchmark, and the TPU ladder in
+# sequence, logging everything to scripts/tpu_validation.log.
+set -u
+LOG=/root/repo/scripts/tpu_validation.log
+cd /root/repo
+echo "=== tpu_validation_run $(date -u) ===" >> "$LOG"
+
+for attempt in $(seq 1 60); do
+  t0=$(date +%s)
+  if timeout -k 5 90 python -c "import jax; jax.devices()" 2>/dev/null; then
+    dt=$(( $(date +%s) - t0 ))
+    echo "probe ok in ${dt}s (attempt $attempt) $(date -u)" >> "$LOG"
+    break
+  fi
+  echo "probe failed (attempt $attempt) $(date -u)" >> "$LOG"
+  sleep 120
+  if [ "$attempt" = 60 ]; then echo "giving up" >> "$LOG"; exit 1; fi
+done
+
+echo "--- test_tpu_hw ---" >> "$LOG"
+timeout 2400 python -m pytest tests/test_tpu_hw.py -q >> "$LOG" 2>&1
+
+echo "--- bench.py ---" >> "$LOG"
+timeout 1800 python bench.py >> "$LOG" 2>/dev/null
+
+echo "--- ladder (tpu, c=16) ---" >> "$LOG"
+timeout 2400 python scripts/ladder_bench.py --n 100 \
+  --genome-len 300000 --skip-rung1 >> "$LOG" 2>/dev/null
+
+echo "=== done $(date -u) ===" >> "$LOG"

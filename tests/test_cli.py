@@ -281,3 +281,32 @@ def test_fraglen_flag_flips_clustering(tmp_path):
                         "--output-representative-list", str(reps_1000)])
     assert rc == 0
     assert reps_1000.read_text() == f"{a}\n{b}\n"  # gated: two reps
+
+
+def test_dist_subcommand_golden_pair(tmp_path):
+    """`dist` (the reference ships this subcommand disabled, reference:
+    src/main.rs:88-114): all-pairs MinHash ANI TSV, pinning the golden
+    set1 sketch ANI 0.9808188 (reference: src/finch.rs:96)."""
+    out = tmp_path / "dist.tsv"
+    rc = _run([
+        "dist", "--genome-fasta-files",
+        f"{DATA}/set1/1mbp.fna", f"{DATA}/set1/500kb.fna",
+        "--output", str(out),
+    ])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert len(lines) == 1
+    a, b, ani = lines[0].split("\t")
+    assert a.endswith("1mbp.fna") and b.endswith("500kb.fna")
+    assert abs(float(ani) - 0.9808188) < 5e-7
+
+
+def test_dist_min_ani_filters(tmp_path):
+    out = tmp_path / "dist.tsv"
+    rc = _run([
+        "dist", "--genome-fasta-files",
+        f"{DATA}/set1/1mbp.fna", f"{DATA}/set1/500kb.fna",
+        "--min-ani", "99", "--output", str(out),
+    ])
+    assert rc == 0
+    assert out.read_text() == ""  # 0.98 < 0.99: filtered out
