@@ -268,7 +268,7 @@ def _rowblock_candidates(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("row_tile", "col_tile", "cap"))
+    static_argnames=("row_tile", "col_tile", "cap", "use_pallas"))
 def _rowblock_screen(
     jmat: jax.Array,     # (n_pad, M) uint64 padded marker matrix
     counts: jax.Array,   # (n_pad,) int32 marker counts per genome
@@ -278,6 +278,7 @@ def _rowblock_screen(
     row_tile: int,
     col_tile: int,
     cap: int,
+    use_pallas: bool = False,
 ):
     """One device dispatch: a (row_tile, n_pad) marker-intersection
     stripe, containment-thresholded and compacted on device.
@@ -295,6 +296,12 @@ def _rowblock_screen(
         def compute(_):
             cols = jax.lax.dynamic_slice_in_dim(
                 jmat, t * col_tile, col_tile, axis=0)
+            if use_pallas:
+                from galah_tpu.ops.pallas_pairwise import (
+                    tile_intersect_pallas,
+                )
+
+                return tile_intersect_pallas(rows, cols)
             return tile_intersect_counts(rows, cols).astype(jnp.int32)
 
         def skip(_):
@@ -322,10 +329,11 @@ def screen_pairs(
     marker_mat: np.ndarray,   # (N, M) uint64 sorted SENTINEL-padded markers
     counts: np.ndarray,       # (N,) marker counts per genome
     c_floor: float,
-    row_tile: int = 64,
-    col_tile: int = 256,
+    row_tile: Optional[int] = None,
+    col_tile: Optional[int] = None,
     cap_per_row: int = 256,
     mesh: "Optional[Mesh]" = None,
+    use_pallas: Optional[bool] = None,
 ) -> list[tuple[int, int]]:
     """i<j pairs whose marker containment >= c_floor, blocked on device.
 
@@ -347,9 +355,49 @@ def screen_pairs(
 
         return sharded_screen_pairs(
             marker_mat, counts, c_floor, mesh=mesh,
-            row_tile=row_tile, col_tile=col_tile,
-            cap_per_row=cap_per_row)
+            row_tile=row_tile if row_tile is not None else 64,
+            col_tile=col_tile if col_tile is not None else 256,
+            cap_per_row=cap_per_row, use_pallas=use_pallas)
 
+    # Mosaic intersect kernel on TPU by default, with the same
+    # explicit-pin / default-fallback policy as threshold_pairs.
+    explicit = use_pallas is not None
+    if use_pallas is None:
+        from galah_tpu.ops.hll import use_pallas_default
+
+        use_pallas = use_pallas_default()
+    # per-path tile defaults, honoring explicit caller values
+    if use_pallas:
+        try:
+            return _screen_pairs_single(
+                marker_mat, counts, c_floor,
+                row_tile if row_tile is not None else 128,
+                col_tile if col_tile is not None else 256,
+                cap_per_row, True)
+        except Exception:
+            if explicit:
+                raise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "Pallas intersect kernel unavailable; falling back to "
+                "the XLA searchsorted path", exc_info=True)
+    return _screen_pairs_single(
+        marker_mat, counts, c_floor,
+        row_tile if row_tile is not None else 64,
+        col_tile if col_tile is not None else 256, cap_per_row,
+        False)
+
+
+def _screen_pairs_single(
+    marker_mat: np.ndarray,
+    counts: np.ndarray,
+    c_floor: float,
+    row_tile: int,
+    col_tile: int,
+    cap_per_row: int,
+    use_pallas: bool,
+) -> list[tuple[int, int]]:
     import math
 
     n = marker_mat.shape[0]
@@ -373,7 +421,8 @@ def screen_pairs(
             n, row_tile, cap_per_row,
             lambda r0, cap: _rowblock_screen(
                 jmat, jcnt, jnp.int32(r0), c_floor_lo, jnp.int32(n),
-                row_tile=row_tile, col_tile=col_tile, cap=cap)):
+                row_tile=row_tile, col_tile=col_tile, cap=cap,
+                use_pallas=use_pallas)):
         count = int(count)
         flat_idx = np.asarray(flat_idx)[:count]
         inter = np.asarray(inter)[:count].astype(np.int64)

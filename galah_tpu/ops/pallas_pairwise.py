@@ -81,14 +81,18 @@ def _ssum_i32(x) -> jax.Array:
     s = jnp.sum(s, axis=0, keepdims=True, dtype=jnp.int32)
     return s[0, 0]
 
-def _make_kernel(la: int, sb: int, bc: int, sketch_size: int):
+def _make_kernel(la: int, sb: int, bc: int, sketch_size: int,
+                 intersect: bool):
     """Kernel for K = 8*la = 128*sb padded sketch width.
 
     One program: rp=8 queries (a 64-sublane block) against all bc
     references. The compare loop batches ALL 8 queries into each
     (64, 128) vector op, so per-pair cost is one-eighth of a
     query-at-a-time formulation; the rank epilogue then runs per query
-    on (8, la) slices.
+    on (8, la) slices. With `intersect` the kernel skips the less-than
+    accumulation and rank math entirely and reports the raw
+    |query ∩ reference| per pair (the marker-screening primitive,
+    ops/pairwise.tile_intersect_counts).
     """
     rp = ROWS_PER_PROGRAM
     nrows = rp * A_SUB  # 64
@@ -130,27 +134,35 @@ def _make_kernel(la: int, sb: int, bc: int, sketch_size: int):
                 for s in range(sb):
                     bh = b_hi_ref[pl.ds(j * sb + s, 1), :]   # (1, 128)
                     bl = b_lo_ref[pl.ds(j * sb + s, 1), :]
-                    lt = (bh < a_h) | ((bh == a_h) & (bl < a_l))
                     eq = (bh == a_h) & (bl == a_l)           # (64, 128)
-                    ltacc = ltacc + lt.astype(jnp.int32)
                     eqacc = eqacc + eq.astype(jnp.int32)
-                lt_scr[:, l:l + 1] = jnp.sum(
-                    ltacc, axis=1, keepdims=True, dtype=jnp.int32)
+                    if not intersect:
+                        lt = (bh < a_h) | ((bh == a_h) & (bl < a_l))
+                        ltacc = ltacc + lt.astype(jnp.int32)
+                if not intersect:
+                    lt_scr[:, l:l + 1] = jnp.sum(
+                        ltacc, axis=1, keepdims=True, dtype=jnp.int32)
                 eq_scr[:, l:l + 1] = jnp.sum(
                     eqacc, axis=1, keepdims=True, dtype=jnp.int32)
 
-            ltv_all = lt_scr[:]
             eqv_all = eq_scr[:]
             hot = (lane == j).astype(jnp.int32)              # (1, bc)
+            if not intersect:
+                ltv_all = lt_scr[:]
 
-            # rank epilogue per query on its (8, la) slice
+            # per-query epilogue on its (8, la) slice
             for q in range(rp):
                 sl = slice(q * A_SUB, (q + 1) * A_SUB)
-                ltv = ltv_all[sl, :]
                 eqv = eqv_all[sl, :]
                 va = valid_a[sl, :]
                 match = ((eqv > 0) & va).astype(jnp.int32)
                 n_common_all = _ssum_i32(match)
+                if intersect:
+                    qmask = (subl == q).astype(jnp.int32)
+                    crows = crows + qmask * (hot * n_common_all)
+                    trows = trows + qmask * (hot * na_q[q])
+                    continue
+                ltv = ltv_all[sl, :]
                 n_union = na_q[q] + nb - n_common_all
                 total = jnp.minimum(jnp.int32(sketch_size), n_union)
 
@@ -198,15 +210,20 @@ def _split_planes(mat: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("sketch_size", "interpret"))
+                   static_argnames=("sketch_size", "interpret",
+                                    "intersect"))
 def tile_stats_pallas(
     rows: jax.Array,   # uint64 (Br, K) sorted asc, SENTINEL-padded
     cols: jax.Array,   # uint64 (Bc, K)
     sketch_size: int,
     interpret: bool = False,
+    intersect: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """(common, total) int32 (Br, Bc) tiles — the Pallas twin of
-    ops/pairwise.tile_stats (bit-identical integers)."""
+    ops/pairwise.tile_stats (bit-identical integers). With `intersect`,
+    `common` is the raw |row ∩ col| count (the twin of
+    ops/pairwise.tile_intersect_counts) and `total` the row's valid
+    count."""
     br_in, k_in = rows.shape
     bc_in = cols.shape[0]
     sent = ~jnp.uint64(0)
@@ -219,7 +236,7 @@ def tile_stats_pallas(
     if bc_in > bc_limit:
         parts = [
             tile_stats_pallas(rows, cols[c0:c0 + bc_limit], sketch_size,
-                              interpret=interpret)
+                              interpret=interpret, intersect=intersect)
             for c0 in range(0, bc_in, bc_limit)
         ]
         return (jnp.concatenate([p[0] for p in parts], axis=1),
@@ -261,7 +278,7 @@ def tile_stats_pallas(
     b_hi2 = b_hi.reshape(bc * sb, B_LANE)
     b_lo2 = b_lo.reshape(bc * sb, B_LANE)
 
-    kernel = _make_kernel(la, sb, bc, sketch_size)
+    kernel = _make_kernel(la, sb, bc, sketch_size, bool(intersect))
     rp = ROWS_PER_PROGRAM
     common, total = pl.pallas_call(
         kernel,
@@ -295,3 +312,16 @@ def tile_stats_pallas(
         interpret=interpret,
     )(a_hi2, a_lo2, b_hi2, b_lo2)
     return common[:br_in, :bc_in], total[:br_in, :bc_in]
+
+
+def tile_intersect_pallas(
+    rows: jax.Array,   # uint64 (Br, M) sorted asc, SENTINEL-padded
+    cols: jax.Array,   # uint64 (Bc, M)
+    interpret: bool = False,
+) -> jax.Array:
+    """|row ∩ col| int32 (Br, Bc) — the Mosaic twin of
+    ops/pairwise.tile_intersect_counts for marker-containment
+    screening (reference: src/skani.rs:54-70)."""
+    common, _total = tile_stats_pallas(
+        rows, cols, rows.shape[1], interpret=interpret, intersect=True)
+    return common

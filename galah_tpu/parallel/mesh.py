@@ -348,6 +348,7 @@ def sharded_screen_pairs(
     row_tile: int = 64,
     col_tile: int = 256,
     cap_per_row: int = 256,
+    use_pallas: Optional[bool] = None,
 ) -> list:
     """i<j pairs with marker containment >= c_floor, columns sharded over
     the mesh — the multi-device twin of ops/pairwise.screen_pairs (the
@@ -356,7 +357,11 @@ def sharded_screen_pairs(
     import math
 
     from galah_tpu.ops.constants import SENTINEL
+    from galah_tpu.ops.hll import use_pallas_default
     from galah_tpu.ops.pairwise import tile_intersect_counts
+
+    if use_pallas is None:
+        use_pallas = use_pallas_default()
 
     n = marker_mat.shape[0]
     n_dev = mesh.devices.size
@@ -384,7 +389,12 @@ def sharded_screen_pairs(
             arrs[0], gt * col_tile, col_tile, axis=0)
         ccnt = jax.lax.dynamic_slice_in_dim(
             arrs[1], gt * col_tile, col_tile, axis=0)
-        inter = tile_intersect_counts(rows, cols).astype(jnp.int32)
+        if use_pallas:
+            from galah_tpu.ops.pallas_pairwise import tile_intersect_pallas
+
+            inter = tile_intersect_pallas(rows, cols)
+        else:
+            inter = tile_intersect_counts(rows, cols).astype(jnp.int32)
         denom = jnp.minimum(rcnt[:, None], ccnt[None, :]).astype(jnp.int32)
         denom = jnp.broadcast_to(denom, inter.shape)
         return inter, denom

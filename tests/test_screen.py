@@ -111,3 +111,23 @@ def test_skani_preclusterer_uses_blocked_screen(ref_data):
     assert cache.contains((0, 1))
     assert cache.contains((0, 3))
     assert cache.contains((1, 3))
+
+
+def test_screen_pairs_pallas_interpret_matches_xla(monkeypatch):
+    """screen_pairs with the Mosaic intersect kernel (interpret mode on
+    the CPU mesh) must equal the XLA searchsorted path exactly."""
+    import galah_tpu.ops.pallas_pairwise as pp
+
+    orig = pp.tile_intersect_pallas
+    monkeypatch.setattr(
+        pp, "tile_intersect_pallas",
+        lambda rows, cols, interpret=False: orig(rows, cols,
+                                                 interpret=True))
+    mat, counts = _marker_fixture(n=60, seed=13)
+    via_pallas = pairwise.screen_pairs(
+        mat, counts, 0.6, row_tile=16, col_tile=32,
+        mesh=make_mesh(1), use_pallas=True)
+    via_xla = pairwise.screen_pairs(
+        mat, counts, 0.6, row_tile=16, col_tile=32,
+        mesh=make_mesh(1), use_pallas=False)
+    assert via_pallas == via_xla
