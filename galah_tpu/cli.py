@@ -103,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=Defaults.FRAGMENT_LENGTH,
                    help="Length of fragment used in fastANI-style "
                         "calculation (default: 3000)")
+    v.add_argument("--ani-subsample", type=int,
+                   default=Defaults.ANI_SUBSAMPLE,
+                   help="FracMinHash compression of the exact ANI "
+                        "re-check (see `cluster --full-help`; "
+                        "default: 1)")
     v.add_argument("--threads", "-t", type=int, default=1)
 
     dd = sub.add_parser(
@@ -268,10 +273,16 @@ def run_cluster_validate(args) -> int:
     ani = parse_percentage(args.ani, "--ani")
     min_af = parse_percentage(args.min_aligned_fraction,
                               "--min-aligned-fraction")
+    subsample = int(getattr(args, "ani_subsample", 1) or 1)
+    if not 1 <= subsample <= 1000:
+        logger.error("--ani-subsample must be in [1, 1000], got %s",
+                     subsample)
+        return 1
     clusterer = FastANIEquivalentClusterer(
         threshold=ani, min_aligned_fraction=min_af,
         fraglen=args.fragment_length,
-        store=ProfileStore(fraglen=args.fragment_length))
+        store=ProfileStore(fraglen=args.fragment_length,
+                           subsample_c=subsample))
     validate_clusters(args.cluster_file, clusterer)
     return 0
 
