@@ -217,21 +217,28 @@ def _synth_families(n_genomes=48, genome_len=60_000, n_families=12,
     return paths
 
 
-def bench_e2e():
-    """Full cluster() wall-clock on planted families -> genomes/s."""
+def bench_e2e(fast=False, paths=None):
+    """Full cluster() wall-clock on planted families -> genomes/s.
+
+    With `fast`, runs the validated fast mode (--hash-algorithm tpufast
+    --ani-subsample 16), which reproduces the dense goldens on the
+    18-MAG campaign (tests/test_campaign_abisko18.py).
+    """
     from galah_tpu.api import generate_galah_clusterer
 
-    paths = _synth_families()
+    paths = paths or _synth_families()
     values = {"ani": 95.0, "precluster_ani": 90.0,
               "min_aligned_fraction": 15.0, "fragment_length": 3000,
               "precluster_method": "finch", "cluster_method": "skani",
               "threads": 1}
+    if fast:
+        values.update(hash_algorithm="tpufast", ani_subsample=16)
     t0 = time.perf_counter()
     clusterer = generate_galah_clusterer(paths, values)
     clusters = clusterer.cluster()
     dt = time.perf_counter() - t0
     assert 1 <= len(clusters) <= len(paths)
-    return len(paths) / dt, len(clusters)
+    return len(paths) / dt, len(clusters), paths
 
 
 def main():
@@ -311,14 +318,23 @@ def main():
         except Exception as e:  # noqa: BLE001
             errors.append(f"sketching-{algo}: {type(e).__name__}: {e}")
 
-    # 6. End-to-end cluster() on planted families.
+    # 6. End-to-end cluster() on planted families, default and fast
+    # mode (each with its own watchdog).
+    paths = None
     try:
         with watchdog(300):
-            gps, n_clusters = bench_e2e()
+            gps, n_clusters, paths = bench_e2e()
             stages["e2e_genomes_per_sec"] = round(gps, 2)
             stages["e2e_n_clusters"] = n_clusters
     except Exception as e:  # noqa: BLE001
         errors.append(f"e2e: {type(e).__name__}: {e}")
+    try:
+        with watchdog(300):
+            gps, n_clusters, _ = bench_e2e(fast=True, paths=paths)
+            stages["e2e_fast_genomes_per_sec"] = round(gps, 2)
+            stages["e2e_fast_n_clusters"] = n_clusters
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"e2e-fast: {type(e).__name__}: {e}")
 
     print(json.dumps(result))
 

@@ -273,7 +273,8 @@ def run_cluster_validate(args) -> int:
     ani = parse_percentage(args.ani, "--ani")
     min_af = parse_percentage(args.min_aligned_fraction,
                               "--min-aligned-fraction")
-    subsample = int(getattr(args, "ani_subsample", 1) or 1)
+    raw = getattr(args, "ani_subsample", None)
+    subsample = int(raw if raw is not None else 1)
     if not 1 <= subsample <= 1000:
         logger.error("--ani-subsample must be in [1, 1000], got %s",
                      subsample)

@@ -167,6 +167,9 @@ def _warm_all_hit_pairs(
     return warm
 
 
+REP_SCAN_WINDOW = 128
+
+
 def _find_representatives(
     clusterer: ClusterBackend,
     pre_cache: PairDistanceCache,
@@ -179,31 +182,66 @@ def _find_representatives(
     Reference: src/clusterer.rs:155-225 (find_dashing_fastani_
     representatives). Genome i becomes a representative iff no existing
     rep with a precluster hit has exact ANI >= threshold.
+
+    Dispatch strategy: the scan is inherently sequential (genome i's
+    candidate set is the reps chosen before it), but ANI VALUES are
+    order-independent, so a window of upcoming genomes is evaluated
+    against all current reps in ONE batched call; only pairs against
+    reps that emerge inside the window need follow-up batches. Device
+    round trips drop from O(N) to O(N/window + #new-reps) per
+    precluster, with decisions identical to the per-genome scan (the
+    extra ANIs computed for window genomes that join a cluster first
+    are the same waste class as the reference's find_any computing an
+    unpredictable candidate subset, reference: src/clusterer.rs:242-262).
     """
     reps: Set[int] = set()
     ani_cache = PairDistanceCache()
     thr = clusterer.ani_threshold
-    for i in range(len(genomes)):
-        cands = [(j, pre_cache.get((i, j))) for j in sorted(reps)
-                 if pre_cache.contains((i, j))]
-        # ascending by precluster ANI — preserved from the reference
-        # (its comment says "highest first" but the sort is ascending,
-        # reference: src/clusterer.rs:167-177)
-        cands.sort(key=lambda t: t[1] if t[1] is not None else -1.0)
+    n = len(genomes)
+
+    def ensure_anis(pairs: List[Tuple[int, int]]) -> None:
+        """Compute (rep, genome) ANIs not already in ani_cache."""
+        missing = [(j, g) for j, g in pairs
+                   if not ani_cache.contains((j, g))]
+        if not missing:
+            return
         anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes,
-                          [(j, i) for j, _ in cands], warm_cache)
-        is_rep = True
-        for (j, _), ani in zip(cands, anis):
-            if ani is not None:
-                # reps always have lower quality rank than i here, but the
-                # cache key is sorted either way
-                ani_cache.insert((j, i), ani)
-                if ani >= thr:
+                          missing, warm_cache)
+        for (j, g), ani in zip(missing, anis):
+            ani_cache.insert((j, g), ani)
+
+    for w0 in range(0, n, REP_SCAN_WINDOW):
+        window = range(w0, min(w0 + REP_SCAN_WINDOW, n))
+        # speculative batch: every window genome vs every CURRENT rep
+        # (order is irrelevant here — ensure_anis just fills the cache)
+        rep_list = list(reps)
+        ensure_anis([(j, g) for g in window for j in rep_list
+                     if pre_cache.contains((g, j))])
+        for i in window:
+            cands = [(j, pre_cache.get((i, j))) for j in sorted(reps)
+                     if pre_cache.contains((i, j))]
+            # ascending by precluster ANI — preserved from the reference
+            # (its comment says "highest first" but the sort is
+            # ascending, reference: src/clusterer.rs:167-177)
+            cands.sort(key=lambda t: t[1] if t[1] is not None else -1.0)
+            # reps that emerged inside the window: their pairs weren't
+            # in the speculative batch
+            ensure_anis([(j, i) for j, _ in cands])
+            is_rep = True
+            for j, _ in cands:
+                ani = ani_cache.get((j, i))
+                if ani is not None and ani >= thr:
                     is_rep = False
-        if is_rep:
-            logger.debug("Genome designated representative: %d %s",
-                         i, genomes[i])
-            reps.add(i)
+                    break
+            if is_rep:
+                logger.debug("Genome designated representative: %d %s",
+                             i, genomes[i])
+                reps.add(i)
+                # speculate forward: the new rep is a candidate for the
+                # REST of the window — batch those pairs now instead of
+                # one small dispatch per subsequent genome
+                ensure_anis([(i, gx) for gx in window if gx > i
+                             and pre_cache.contains((gx, i))])
     return reps, ani_cache
 
 

@@ -181,3 +181,28 @@ def test_cache_transform_ids():
 def test_pair_key_sorted():
     assert pair_key(5, 2) == (2, 5)
     assert pair_key(2, 5) == (2, 5)
+
+
+def test_windowed_rep_scan_bounds_dispatches():
+    """A large precluster (above the dense-warm cap) must issue far
+    fewer backend batches than one per genome: the windowed rep scan
+    (engine.REP_SCAN_WINDOW) batches a window of upcoming genomes
+    against all current reps speculatively."""
+    n = 200
+    # one family: genome 0 absorbs everyone (ANI 0.99 to all); all
+    # pairs are precluster hits so the candidate sets are maximal
+    pre_pairs = {(i, j): 0.97 for i in range(n) for j in range(i + 1, n)}
+    table = {}
+    for i in range(n):
+        for j in range(i + 1, n):
+            # chain to rep 0 only: others stay below threshold
+            table[(f"g{i}.fna", f"g{j}.fna")] = 0.99 if i == 0 else 0.80
+    pre = StubPreclusterer(pre_pairs, name="pre")
+    cl = StubClusterer(table, threshold=0.95, name="exact")
+    clusters = cluster(g(n), pre, cl, dense_precluster_cap=0)
+    assert sorted(len(c) for c in clusters)[-1] == n  # one big cluster
+    # one speculative batch per 128-genome window (2 windows at n=200),
+    # plus one batch per genome that saw a rep emerge inside its window
+    # (only genome 1: rep 0 emerges in window 0 before it). Allow a
+    # little slack but pin "far fewer than n".
+    assert len(cl.calls) <= 8, len(cl.calls)
