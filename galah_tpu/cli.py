@@ -30,6 +30,9 @@ def _add_verbosity(p: argparse.ArgumentParser) -> None:
                    help="Unless there is an error, do not print log messages")
     p.add_argument("--full-help", action="store_true",
                    help="Display an extended man-style help page and exit")
+    p.add_argument("--full-help-roff", action="store_true",
+                   help="Print the extended help as raw roff man source "
+                        "and exit (pipe through `man -l -`)")
 
 
 def _add_genome_inputs(p: argparse.ArgumentParser) -> None:
@@ -294,6 +297,13 @@ def main(argv=None) -> int:
     if args.subcommand is None:
         parser.print_help()
         return 1
+    if getattr(args, "full_help_roff", False):
+        from galah_tpu.manpage import render_full_help_roff
+
+        sys.stdout.write(render_full_help_roff(
+            parser._subcommand_parsers[args.subcommand],
+            args.subcommand))
+        return 0
     if getattr(args, "full_help", False):
         from galah_tpu.manpage import print_full_help
 

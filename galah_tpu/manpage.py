@@ -159,6 +159,82 @@ def render_full_help(parser: argparse.ArgumentParser,
     return "\n".join(out)
 
 
+def render_full_help_roff(parser: argparse.ArgumentParser,
+                          subcommand: str) -> str:
+    """The same page as groff man source (the reference renders its
+    help through roff via bird_tool_utils-man; --full-help-roff exposes
+    the source the same way)."""
+    import galah_tpu
+
+    def esc(t: str) -> str:
+        return t.replace("\\", "\\\\").replace("-", "\\-")
+
+    by_flag = {}
+    general = []
+    for action in parser._actions:
+        if not action.option_strings:
+            continue
+        key = action.option_strings[-1]
+        by_flag[key] = action
+        general.append(key)
+
+    prog = f"galah-tpu {subcommand}"
+    out = [
+        f'.TH "{prog.upper().replace(" ", "-")}" "1" "" '
+        f'"galah-tpu {galah_tpu.__version__}" "User Commands"',
+        ".SH NAME",
+        f"{esc(prog)} \\- {esc(parser.description or '')}",
+    ]
+
+    def emit_action(action) -> None:
+        names = ", ".join(f"\\fB{esc(o)}\\fR"
+                          for o in action.option_strings)
+        if action.metavar or (action.nargs != 0
+                              and action.const is None
+                              and not isinstance(action.nargs, int)
+                              and action.type is not None
+                              or action.choices):
+            names += " \\fI<value>\\fR"
+        out.append(".TP")
+        out.append(names)
+        help_text = action.help or ""
+        if action.choices:
+            help_text += (" [choices: "
+                          + ", ".join(map(str, action.choices)) + "]")
+        out.append(esc(help_text))
+
+    used = set()
+    for title, prose, flags in _SECTIONS:
+        present = [f for f in flags if f in by_flag]
+        if not present:
+            continue
+        out.append(f".SH {title}")
+        if prose:
+            out.append(esc(prose))
+        for f in present:
+            emit_action(by_flag[f])
+            used.add(f)
+    rest = [f for f in general if f not in used and f != "--help"]
+    if rest:
+        out.append(".SH OTHER GENERAL OPTIONS")
+        for f in rest:
+            emit_action(by_flag[f])
+    epilog = _EPILOGS.get(subcommand, "")
+    for block in epilog.split("\n\n"):
+        if not block.strip():
+            continue
+        first, _, restb = block.partition("\n")
+        if first.isupper():
+            out.append(f".SH {first.strip()}")
+            if restb:
+                out.append(".nf")
+                out.append(esc(restb))
+                out.append(".fi")
+        else:
+            out.append(esc(block))
+    return "\n".join(out) + "\n"
+
+
 def print_full_help(parser: argparse.ArgumentParser,
                     subcommand: str) -> None:
     text = render_full_help(parser, subcommand)

@@ -28,3 +28,17 @@ def test_every_cluster_flag_appears_in_page():
             if flag in ("-h", "--help"):
                 continue
             assert flag in page, f"{flag} missing from full help"
+
+
+def test_full_help_roff(capsys):
+    """--full-help-roff prints groff man source (the reference renders
+    its help through roff, reference: src/cluster_argument_parsing.rs
+    --full-help-roff)."""
+    from galah_tpu import cli
+
+    assert cli.main(["cluster", "--full-help-roff"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith(".TH")
+    assert ".SH NAME" in out
+    assert ".SH CLUSTERING PARAMETERS" in out
+    assert "\\-\\-precluster\\-method" in out
