@@ -81,8 +81,29 @@ def _ssum_i32(x) -> jax.Array:
     s = jnp.sum(s, axis=0, keepdims=True, dtype=jnp.int32)
     return s[0, 0]
 
+def _pairmin(h1, l1, h2, l2):
+    take2 = (h2 < h1) | ((h2 == h1) & (l2 < l1))
+    return jnp.where(take2, h2, h1), jnp.where(take2, l2, l1)
+
+
+def _pairmax(h1, l1, h2, l2):
+    take2 = (h2 > h1) | ((h2 == h1) & (l2 > l1))
+    return jnp.where(take2, h2, h1), jnp.where(take2, l2, l1)
+
+
+def _col_reduce(h, low, op):
+    """Per-column u64 min/max over sublanes of u32 (rows, la) planes
+    via a slicing tournament -> (1, la) planes."""
+    rows = h.shape[0]
+    while rows > 1:
+        half = rows // 2
+        h, low = op(h[:half], low[:half], h[half:rows], low[half:rows])
+        rows = half
+    return h, low
+
+
 def _make_kernel(la: int, sb: int, bc: int, sketch_size: int,
-                 intersect: bool):
+                 intersect: bool, range_skip: bool):
     """Kernel for K = 8*la = 128*sb padded sketch width.
 
     One program: rp=8 queries (a 64-sublane block) against all bc
@@ -110,16 +131,28 @@ def _make_kernel(la: int, sb: int, bc: int, sketch_size: int,
             _ssum_i32(valid_a[q * A_SUB:(q + 1) * A_SUB, :])
             for q in range(rp)
         ]
+        if range_skip:
+            # per-column u64 min/max over all 64 query values, once per
+            # program: the skip tests below compare b-chunk endpoint
+            # scalars against these
+            amin_h, amin_l = _col_reduce(ah, al, _pairmin)   # (1, la)
+            amax_h, amax_l = _col_reduce(ah, al, _pairmax)
 
         def j_body(j, carry):
             crows, trows = carry      # (rp, bc) int32 accumulators
 
-            # reference j's valid count (shared by all queries)
+            # reference j's valid count (shared by all queries); b rows
+            # are sorted, so chunk endpoints are free scalar extracts
             nb = jnp.int32(0)
+            b_first = []
+            b_last = []
             for s in range(sb):
                 bh = b_hi_ref[pl.ds(j * sb + s, 1), :]
                 bl = b_lo_ref[pl.ds(j * sb + s, 1), :]
                 nb = nb + _ssum_i32(~((bh == umax) & (bl == umax)))
+                if range_skip:
+                    b_first.append((bh[0, 0], bl[0, 0]))
+                    b_last.append((bh[0, B_LANE - 1], bl[0, B_LANE - 1]))
 
             # compare loop: for each a-chunk column l, all 8 queries'
             # chunk-l elements (64, 1) against every b chunk (1, 128);
@@ -129,6 +162,50 @@ def _make_kernel(la: int, sb: int, bc: int, sketch_size: int,
             for l in range(la):
                 a_h = ah[:, l:l + 1]  # (64, 1) — static lane slice
                 a_l = al[:, l:l + 1]
+                if range_skip:
+                    # chunks wholly below the column minimum form a
+                    # PREFIX (b sorted): they contribute 128 to every
+                    # lt count and nothing to eq; chunks wholly above
+                    # the maximum form a suffix and contribute nothing.
+                    # A wholly-below chunk can't hold sentinels (its
+                    # max would be UMAX), so its valid count is exactly
+                    # B_LANE. Only [s_lo, s_hi) compares elementwise.
+                    mn_h = amin_h[0, l]
+                    mn_l = amin_l[0, l]
+                    mx_h = amax_h[0, l]
+                    mx_l = amax_l[0, l]
+                    s_lo = jnp.int32(0)
+                    s_hi = jnp.int32(sb)
+                    for s in range(sb):
+                        fh, fl = b_first[s]
+                        lh, ll = b_last[s]
+                        below = (lh < mn_h) | ((lh == mn_h) & (ll < mn_l))
+                        above = (fh > mx_h) | ((fh == mx_h) & (fl > mx_l))
+                        s_lo = s_lo + below.astype(jnp.int32)
+                        s_hi = s_hi - above.astype(jnp.int32)
+
+                    def body(s, carry, a_h=a_h, a_l=a_l):
+                        lt_c, eq_c = carry
+                        bh = b_hi_ref[pl.ds(j * sb + s, 1), :]
+                        bl = b_lo_ref[pl.ds(j * sb + s, 1), :]
+                        eq = (bh == a_h) & (bl == a_l)
+                        eq_c = eq_c + eq.astype(jnp.int32)
+                        if not intersect:
+                            lt = (bh < a_h) | ((bh == a_h) & (bl < a_l))
+                            lt_c = lt_c + lt.astype(jnp.int32)
+                        return lt_c, eq_c
+
+                    zero = jnp.zeros((nrows, B_LANE), jnp.int32)
+                    ltacc, eqacc = jax.lax.fori_loop(
+                        s_lo, jnp.maximum(s_hi, s_lo), body, (zero, zero))
+                    if not intersect:
+                        lt_scr[:, l:l + 1] = (
+                            jnp.sum(ltacc, axis=1, keepdims=True,
+                                    dtype=jnp.int32)
+                            + s_lo * jnp.int32(B_LANE))
+                    eq_scr[:, l:l + 1] = jnp.sum(
+                        eqacc, axis=1, keepdims=True, dtype=jnp.int32)
+                    continue
                 ltacc = jnp.zeros((nrows, B_LANE), jnp.int32)
                 eqacc = jnp.zeros((nrows, B_LANE), jnp.int32)
                 for s in range(sb):
@@ -211,13 +288,14 @@ def _split_planes(mat: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 @functools.partial(jax.jit,
                    static_argnames=("sketch_size", "interpret",
-                                    "intersect"))
+                                    "intersect", "range_skip"))
 def tile_stats_pallas(
     rows: jax.Array,   # uint64 (Br, K) sorted asc, SENTINEL-padded
     cols: jax.Array,   # uint64 (Bc, K)
     sketch_size: int,
     interpret: bool = False,
     intersect: bool = False,
+    range_skip: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """(common, total) int32 (Br, Bc) tiles — the Pallas twin of
     ops/pairwise.tile_stats (bit-identical integers). With `intersect`,
@@ -236,7 +314,8 @@ def tile_stats_pallas(
     if bc_in > bc_limit:
         parts = [
             tile_stats_pallas(rows, cols[c0:c0 + bc_limit], sketch_size,
-                              interpret=interpret, intersect=intersect)
+                              interpret=interpret, intersect=intersect,
+                              range_skip=range_skip)
             for c0 in range(0, bc_in, bc_limit)
         ]
         return (jnp.concatenate([p[0] for p in parts], axis=1),
@@ -278,7 +357,8 @@ def tile_stats_pallas(
     b_hi2 = b_hi.reshape(bc * sb, B_LANE)
     b_lo2 = b_lo.reshape(bc * sb, B_LANE)
 
-    kernel = _make_kernel(la, sb, bc, sketch_size, bool(intersect))
+    kernel = _make_kernel(la, sb, bc, sketch_size, bool(intersect),
+                          bool(range_skip))
     rp = ROWS_PER_PROGRAM
     common, total = pl.pallas_call(
         kernel,

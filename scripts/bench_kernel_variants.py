@@ -1,0 +1,53 @@
+"""Measure the Mosaic pair-stats kernel variants on the live TPU.
+
+Run when the tunnel is healthy; timings force host materialization
+(block_until_ready does not block through the tunnel). Decides whether
+range_skip should become the default inside tile_stats_pallas.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from galah_tpu.ops.pairwise import tile_stats
+    from galah_tpu.ops.pallas_pairwise import tile_stats_pallas
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    rng = np.random.default_rng(1)
+    K = 1000
+
+    def mats(n):
+        m = rng.integers(0, 1 << 63, size=(2 * n, K), dtype=np.uint64)
+        m.sort(axis=1)
+        return jnp.asarray(m[:n]), jnp.asarray(m[n:])
+
+    for n in (128, 512):
+        r, c = mats(n)
+        for label, fn in (
+            ("xla", lambda: tile_stats(r, c, K, 21)),
+            ("pallas", lambda: tile_stats_pallas(r, c, K)),
+            ("pallas+skip",
+             lambda: tile_stats_pallas(r, c, K, range_skip=True)),
+        ):
+            out = fn()
+            ref = int(np.asarray(out[0]).sum())  # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                got = int(np.asarray(fn()[0]).sum())
+                best = min(best, time.perf_counter() - t0)
+            assert got == ref
+            print(f"{label} {n}x{n}: {best*1e3:.1f} ms = "
+                  f"{n*n/best:,.0f} pairs/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -95,3 +95,18 @@ def test_threshold_pairs_pallas_interpret_matches_xla():
     assert (7, 33) in via_pallas
     for key in via_pallas:
         assert abs(via_pallas[key] - via_xla[key]) < 1e-5
+
+
+def test_minhash_pair_stats_range_skip_parity():
+    """The range-skip variant (prefix bulk-count + suffix skip over
+    sorted b-chunks) must stay bit-identical to the XLA path."""
+    rng = np.random.default_rng(21)
+    rows = _rand_sketches(rng, 6, 1000, 1000)
+    cols = _rand_sketches(rng, 7, 1000, 1000)
+    cols[2] = rows[3]
+    c_p, t_p = tile_stats_pallas(jnp.asarray(rows), jnp.asarray(cols),
+                                 1000, interpret=True, range_skip=True)
+    c_x, t_x = tile_stats(jnp.asarray(rows), jnp.asarray(cols),
+                          1000, 21)
+    np.testing.assert_array_equal(np.asarray(c_p), np.asarray(c_x))
+    np.testing.assert_array_equal(np.asarray(t_p), np.asarray(t_x))
