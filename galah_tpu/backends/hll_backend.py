@@ -64,12 +64,20 @@ class HLLPreclusterer(PreclusterBackend):
                 genome_paths, probe, read_genome)
             for path, row in hits.items():
                 regs[index[path]] = row
-            for path, genome in miss_iter:
-                row = hll.hll_sketch_genome(
-                    genome, p=self.p, k=self.k, seed=self.seed,
-                    algo=self.algo)
-                regs[index[path]] = row
-                self.cache.store(path, "hll", params, {"regs": row})
+            # Batch cache misses into grouped one-dispatch sketches (the
+            # prefetch look-ahead hides at most `depth` ingestions behind
+            # each dispatch).
+            from galah_tpu.io.prefetch import iter_batches
+            from galah_tpu.ops.hashing import BATCH_BUDGET
+
+            for buf in iter_batches(
+                    miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET):
+                rows = hll.hll_sketch_genomes_batch(
+                    [g for _, g in buf], p=self.p, k=self.k,
+                    seed=self.seed, algo=self.algo)
+                for (path, _), row in zip(buf, rows):
+                    regs[index[path]] = row
+                    self.cache.store(path, "hll", params, {"regs": row})
 
         logger.info("Computing tiled all-pairs HLL ANI ..")
         with timing.stage("pairwise-hll"):

@@ -18,7 +18,12 @@ from galah_tpu.config import Defaults
 from galah_tpu.io import diskcache
 from galah_tpu.io.diskcache import CacheDir
 from galah_tpu.io.fasta import read_genome
-from galah_tpu.ops.minhash import sketch_genome_device, sketch_matrix
+from galah_tpu.ops.minhash import (
+    BATCH_BUDGET,
+    sketch_genome_device,
+    sketch_genomes_device_batch,
+    sketch_matrix,
+)
 from galah_tpu.ops.minhash_np import MinHashSketch
 from galah_tpu.ops.pairwise import threshold_pairs
 from galah_tpu.utils import timing
@@ -70,6 +75,17 @@ class SketchStore:
         self._sketches[path] = s
         return s
 
+    def put_from_genomes(self, items) -> None:
+        """Batch-sketch [(path, genome)] — grouped device dispatches
+        (ops/minhash.sketch_genomes_device_batch), bit-identical results."""
+        sketches = sketch_genomes_device_batch(
+            [g for _, g in items], sketch_size=self.sketch_size,
+            k=self.k, seed=self.seed, algo=self.algo)
+        for (p, _), s in zip(items, sketches):
+            self.cache.store(p, "minhash", self._params(),
+                             {"hashes": s.hashes})
+            self._sketches[p] = s
+
     def get(self, path: str) -> MinHashSketch:
         s = self.get_cached(path)
         if s is not None:
@@ -101,15 +117,23 @@ class MinHashPreclusterer(PreclusterBackend):
             "Sketching MinHash representations of %d genomes on device ..",
             len(genome_paths))
         with timing.stage("sketch-minhash"):
-            from galah_tpu.io.prefetch import probe_and_prefetch
+            from galah_tpu.io.prefetch import (
+                iter_batches,
+                probe_and_prefetch,
+            )
 
             # cache misses: ingestion prefetched on host threads while
             # the device sketches the previous genome
             by_path, miss_iter = probe_and_prefetch(
                 genome_paths, self.store.get_cached, read_genome)
-            for p, genome in miss_iter:
-                by_path[p] = self.store.put_from_genome(p, genome)
-            sketches = [by_path[p] for p in genome_paths]
+            # Batch cache misses into grouped device dispatches (the
+            # prefetch look-ahead hides at most `depth` ingestions behind
+            # each dispatch).
+            for buf in iter_batches(
+                    miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET):
+                self.store.put_from_genomes(buf)
+            sketches = [by_path.get(p) or self.store.get(p)
+                        for p in genome_paths]
             mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
         logger.info("Computing tiled all-pairs Mash ANI ..")
         with timing.stage("pairwise-minhash"):

@@ -42,6 +42,29 @@ def probe_and_prefetch(
     return hits, iter_prefetched(misses, load_fn, depth=depth)
 
 
+def iter_batches(
+    items: Iterator[Tuple[str, T]],
+    size_fn: Callable[[T], int],
+    budget: int,
+    max_items: int = 512,
+) -> Iterator[list]:
+    """Group a (path, item) stream into buffers of at most `budget` total
+    size (per `size_fn`) or `max_items` entries — the one
+    accumulate-then-flush policy shared by the batched sketching
+    backends. The underlying prefetch threads keep loading ahead while
+    the caller processes each yielded buffer."""
+    buf: list = []
+    total = 0
+    for path, item in items:
+        buf.append((path, item))
+        total += int(size_fn(item))
+        if total >= budget or len(buf) >= max_items:
+            yield buf
+            buf, total = [], 0
+    if buf:
+        yield buf
+
+
 def iter_prefetched(
     paths: Sequence[str],
     load_fn: Callable[[str], T],

@@ -66,7 +66,7 @@ def hll_sketch_genome(
     p: int = DEFAULT_P,
     k: int = 21,
     seed: int = 0,
-    chunk: int = 1 << 23,
+    chunk: int = hashing.DEFAULT_CHUNK,
     algo: str = "murmur3",
 ) -> np.ndarray:
     """(2^p,) uint8 HLL registers over the genome's canonical k-mers."""
@@ -76,6 +76,43 @@ def hll_sketch_genome(
             seed=seed, algo=algo):
         regs = _hll_update(regs, hashes, p)
     return np.asarray(regs)
+
+
+@functools.partial(jax.jit, static_argnames=("p", "k", "seed", "algo"))
+def _batch_hll_kernel(packed, ambits, offsets, p, k, seed, algo):
+    """(G, C/4) packed genome rows -> (G, 2^p) uint8 HLL registers in one
+    dispatch (vmapped hash + per-row register fold)."""
+    h = hashing.canonical_kmer_hashes_batch(
+        packed, ambits, offsets, k, seed, algo)
+    return jax.vmap(
+        lambda hrow: _hll_update(jnp.zeros((1 << p,), jnp.uint8),
+                                 hrow, p))(h)
+
+
+def hll_sketch_genomes_batch(
+    genomes,
+    p: int = DEFAULT_P,
+    k: int = 21,
+    seed: int = 0,
+    algo: str = "murmur3",
+    budget: int = hashing.BATCH_BUDGET,
+) -> list:
+    """Batch twin of hll_sketch_genome: grouped one-dispatch sketching
+    of many genomes (see ops/minhash.sketch_genomes_device_batch for the
+    rationale), bit-identical registers per genome."""
+    out = [None] * len(genomes)
+    skipped, group_iter = hashing.iter_genome_groups(
+        genomes, budget=budget, max_len=hashing.DEFAULT_CHUNK)
+    for i in skipped:
+        out[i] = hll_sketch_genome(genomes[i], p=p, k=k, seed=seed,
+                                   algo=algo)
+    for chunk_idxs, packed, ambits, offs in group_iter:
+        regs = np.asarray(_batch_hll_kernel(
+            jnp.asarray(packed), jnp.asarray(ambits), jnp.asarray(offs),
+            p=p, k=k, seed=seed, algo=algo))
+        for row, gi in enumerate(chunk_idxs):
+            out[gi] = regs[row]
+    return out
 
 
 def _estimate(regs_f32_powsum: jax.Array, zeros: jax.Array,

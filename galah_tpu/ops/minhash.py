@@ -9,9 +9,11 @@ all genomes and contigs regardless of length.
 
 from __future__ import annotations
 
-from typing import Sequence
+import functools
+from typing import List, Sequence
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from galah_tpu.config import Defaults
@@ -20,12 +22,10 @@ from galah_tpu.ops import hashing
 from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.minhash_np import MinHashSketch
 
-# 8 Mi positions per chunk (iter_chunk_hashes buckets it down to the
-# genome size in 64 Ki steps): one dispatch covers most MAGs — through a
-# remote-tunnel TPU the per-dispatch round trip dominates hashing
-# launches. The hash pipeline is 1-D shifted slices (ops/hashing.py),
-# so chunk memory is a few uint64 arrays of C elements.
-DEFAULT_CHUNK = 1 << 23
+# Chunk/budget policy lives with the chunk iterator (ops/hashing.py);
+# re-exported here for existing importers.
+DEFAULT_CHUNK = hashing.DEFAULT_CHUNK
+BATCH_BUDGET = hashing.BATCH_BUDGET
 
 
 def sketch_genome_device(
@@ -47,6 +47,62 @@ def sketch_genome_device(
     out = np.asarray(running)
     out = out[out != np.uint64(SENTINEL)]
     return MinHashSketch(hashes=out, sketch_size=sketch_size, kmer=k)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "seed", "algo", "sketch_size"))
+def _batch_sketch_kernel(packed, ambits, offsets, k, seed, algo,
+                         sketch_size):
+    """(G, C/4) packed genome rows -> (G, sketch_size) sorted distinct
+    bottom-k hashes (SENTINEL-padded). One dispatch for the whole group."""
+    h = hashing.canonical_kmer_hashes_batch(
+        packed, ambits, offsets, k, seed, algo)
+    h = jnp.sort(h, axis=-1)
+    dup = jnp.concatenate(
+        [jnp.zeros((h.shape[0], 1), bool), h[:, 1:] == h[:, :-1]], axis=1)
+    h = jnp.where(dup, hashing.HASH_SENTINEL, h)
+    h = jnp.sort(h, axis=-1)
+    return h[:, : min(sketch_size, h.shape[1])]
+
+
+def sketch_genomes_device_batch(
+    genomes: Sequence[Genome],
+    sketch_size: int = Defaults.MINHASH_SKETCH_SIZE,
+    k: int = Defaults.MINHASH_KMER,
+    seed: int = Defaults.MINHASH_SEED,
+    algo: str = Defaults.HASH_ALGO,
+    budget: int = BATCH_BUDGET,
+) -> List[MinHashSketch]:
+    """Sketch many genomes in a handful of dispatches, bit-identical to
+    sketch_genome_device per genome.
+
+    Genomes are bucketed by 64 Ki-padded length (bounding compile
+    variants) and packed into (G, L) groups of at most `budget` total
+    positions; each group is one device dispatch (hash + row-wise
+    distinct bottom-k). Through a tunneled TPU the per-dispatch round
+    trip otherwise dominates small-genome sketching (reference analog:
+    finch sketch_files, src/finch.rs:47, a host-parallel per-file loop).
+    Genomes longer than DEFAULT_CHUNK fall back to the chunked
+    single-genome path.
+    """
+    out: List[MinHashSketch] = [None] * len(genomes)  # type: ignore
+    skipped, group_iter = hashing.iter_genome_groups(
+        genomes, budget=budget, max_len=DEFAULT_CHUNK)
+    for i in skipped:
+        out[i] = sketch_genome_device(
+            genomes[i], sketch_size=sketch_size, k=k, seed=seed,
+            algo=algo)
+    for chunk_idxs, packed, ambits, offs in group_iter:
+        mat = np.asarray(_batch_sketch_kernel(
+            jnp.asarray(packed), jnp.asarray(ambits),
+            jnp.asarray(offs), k=k, seed=seed, algo=algo,
+            sketch_size=sketch_size))
+        for row, gi in enumerate(chunk_idxs):
+            hs = mat[row]
+            hs = hs[hs != np.uint64(SENTINEL)]
+            out[gi] = MinHashSketch(
+                hashes=hs, sketch_size=sketch_size, kmer=k)
+    return out
 
 
 def sketch_matrix(

@@ -117,3 +117,23 @@ def test_hll_fastani_golden_clusters(ref_data):
     out98 = cluster(paths, pre, FastANIEquivalentClusterer(
         threshold=0.98, min_aligned_fraction=0.2))
     assert sorted(sorted(c) for c in out98) == [[0, 1, 3], [2]]
+
+
+def test_hll_batch_sketch_matches_single(tmp_path):
+    """hll_sketch_genomes_batch registers are bit-identical per genome."""
+    import numpy as np
+
+    from galah_tpu.io import read_genome
+    from galah_tpu.ops import hll
+
+    rng = np.random.default_rng(11)
+    genomes = []
+    for i, seq_len in enumerate([120, 4000, 70_000]):
+        seq = "".join(rng.choice(list("ACGT"), size=seq_len))
+        p = tmp_path / f"h{i}.fna"
+        p.write_text(f">a\n{seq[: seq_len // 2]}N{seq[seq_len // 2:]}\n")
+        genomes.append(read_genome(str(p)))
+    batch = hll.hll_sketch_genomes_batch(genomes, p=10)
+    for g, regs in zip(genomes, batch):
+        single = hll.hll_sketch_genome(g, p=10)
+        np.testing.assert_array_equal(single, regs)

@@ -145,3 +145,45 @@ def test_device_sketch_golden_chunked(ref_data):
     s2 = sketch_genome_device(g2)
     ani = minhash_np.mash_ani(s1, s2)
     assert np.float32(ani) == np.float32(0.9808188)
+
+
+def test_batch_sketch_matches_single(tmp_path, ref_data):
+    """sketch_genomes_device_batch is bit-identical to the per-genome
+    chunked path across length buckets, contig breaks, and N masking."""
+    from galah_tpu.ops.minhash import (
+        sketch_genome_device,
+        sketch_genomes_device_batch,
+    )
+
+    rng = np.random.default_rng(5)
+    genomes = []
+    for i, seq_len in enumerate([80, 3000, 70_000, 70_500]):
+        seq = "".join(rng.choice(list("ACGT"), size=seq_len))
+        p = tmp_path / f"g{i}.fna"
+        p.write_text(f">a\n{seq[: seq_len // 2]}N{seq[seq_len // 2:]}\n"
+                     f">b\n{seq[:50]}\n")
+        genomes.append(read_genome(str(p)))
+    genomes.append(read_genome(str(ref_data / "set1" / "500kb.fna")))
+
+    batch = sketch_genomes_device_batch(genomes, sketch_size=64)
+    for g, s in zip(genomes, batch):
+        single = sketch_genome_device(g, sketch_size=64)
+        np.testing.assert_array_equal(single.hashes, s.hashes)
+
+
+def test_batch_sketch_tiny_budget_groups(tmp_path):
+    """Groups split by the position budget still cover every genome."""
+    from galah_tpu.ops.minhash import sketch_genomes_device_batch
+
+    rng = np.random.default_rng(6)
+    genomes = []
+    for i in range(5):
+        seq = "".join(rng.choice(list("ACGT"), size=500 + 17 * i))
+        p = tmp_path / f"t{i}.fna"
+        p.write_text(f">c\n{seq}\n")
+        genomes.append(read_genome(str(p)))
+    a = sketch_genomes_device_batch(genomes, sketch_size=32,
+                                    budget=1 << 16)
+    b = sketch_genomes_device_batch(genomes, sketch_size=32)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x.hashes, y.hashes)
