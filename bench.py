@@ -190,6 +190,29 @@ def bench_sketching(algo="murmur3"):
     return total_bp / dt
 
 
+def bench_sketching_batch(algo="murmur3"):
+    """Grouped-dispatch batch sketching throughput on real FASTA bytes."""
+    import glob
+
+    from galah_tpu.io.fasta import read_genome
+    from galah_tpu.ops.minhash import sketch_genomes_device_batch
+
+    paths = sorted(glob.glob(
+        "/root/reference/tests/data/abisko4/*.fna"))[:6]
+    if not paths:
+        return None
+    genomes = [read_genome(p) for p in paths]
+    total_bp = sum(int(g.codes.shape[0]) for g in genomes)
+    sketch_genomes_device_batch(genomes, sketch_size=SKETCH_SIZE, k=K,
+                                seed=0, algo=algo)  # compile
+    t0 = time.perf_counter()
+    out = sketch_genomes_device_batch(genomes, sketch_size=SKETCH_SIZE,
+                                      k=K, seed=0, algo=algo)
+    dt = time.perf_counter() - t0
+    assert all(s.hashes.shape[0] > 0 for s in out)
+    return total_bp / dt
+
+
 def _synth_families(n_genomes=48, genome_len=60_000, n_families=12,
                     mut=0.03, seed=7, outdir=None):
     """Plant n_families mutated-copy families; returns FASTA paths."""
@@ -317,6 +340,13 @@ def main():
                     stages[key] = round(bps, 1)
         except Exception as e:  # noqa: BLE001
             errors.append(f"sketching-{algo}: {type(e).__name__}: {e}")
+    try:
+        with watchdog(240):
+            bps = bench_sketching_batch("murmur3")
+            if bps:
+                stages["sketch_batch_bp_per_sec"] = round(bps, 1)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"sketching-batch: {type(e).__name__}: {e}")
 
     # 6. End-to-end cluster() on planted families, default and fast
     # mode (each with its own watchdog).

@@ -94,31 +94,75 @@ class ProfileStore:
             while len(self._cache) > self.maxsize:
                 self._cache.popitem(last=False)
 
+    def _insert(self, path: str, prof: GenomeProfile) -> None:
+        self._cache[path] = prof
+        if len(self._cache) > self.maxsize:
+            self._cache.popitem(last=False)
+
+    def _load_disk(self, path: str) -> Optional[GenomeProfile]:
+        entry = self.disk.load(path, "profile", self._params())
+        if entry is None:
+            return None
+        return GenomeProfile(
+            path=path, k=self.k, fraglen=self.fraglen,
+            flat_hashes=entry["flat_hashes"],
+            ref_set=entry["ref_set"], markers=entry["markers"],
+            subsample_c=self.subsample_c)
+
+    def _store_disk(self, path: str, prof: GenomeProfile) -> None:
+        self.disk.store(path, "profile", self._params(), {
+            "flat_hashes": prof.flat_hashes,
+            "ref_set": prof.ref_set,
+            "markers": prof.markers,
+        })
+
     def get(self, path: str) -> GenomeProfile:
         prof = self._cache.get(path)
         if prof is not None:
             self._cache.move_to_end(path)
             return prof
-        entry = self.disk.load(path, "profile", self._params())
-        if entry is not None:
-            prof = GenomeProfile(
-                path=path, k=self.k, fraglen=self.fraglen,
-                flat_hashes=entry["flat_hashes"],
-                ref_set=entry["ref_set"], markers=entry["markers"],
-                subsample_c=self.subsample_c)
-        else:
+        prof = self._load_disk(path)
+        if prof is None:
             prof = fragment_ani.build_profile(
                 read_genome(path), k=self.k, fraglen=self.fraglen,
                 subsample_c=self.subsample_c)
-            self.disk.store(path, "profile", self._params(), {
-                "flat_hashes": prof.flat_hashes,
-                "ref_set": prof.ref_set,
-                "markers": prof.markers,
-            })
-        self._cache[path] = prof
-        if len(self._cache) > self.maxsize:
-            self._cache.popitem(last=False)
+            self._store_disk(path, prof)
+        self._insert(path, prof)
         return prof
+
+    def get_many(self, paths: Sequence[str]) -> "list[GenomeProfile]":
+        """Profiles for many paths; cache misses are ingested with the
+        prefetch pool and hashed in grouped batch dispatches
+        (ops/fragment_ani.build_profiles_batch) instead of one dispatch
+        per genome."""
+        from galah_tpu.io.prefetch import iter_batches, iter_prefetched
+
+        by_path: "dict[str, GenomeProfile]" = {}
+        misses = []
+        for p in dict.fromkeys(paths):
+            prof = self._cache.get(p)
+            if prof is not None:
+                self._cache.move_to_end(p)
+                by_path[p] = prof
+                continue
+            prof = self._load_disk(p)
+            if prof is not None:
+                self._insert(p, prof)
+                by_path[p] = prof
+            else:
+                misses.append(p)
+        for buf in iter_batches(
+                iter_prefetched(misses, read_genome),
+                lambda g: g.codes.shape[0],
+                budget=fragment_ani.PROFILE_BATCH_BUDGET):
+            profs = fragment_ani.build_profiles_batch(
+                [g for _, g in buf], k=self.k, fraglen=self.fraglen,
+                subsample_c=self.subsample_c)
+            for (p, _), prof in zip(buf, profs):
+                self._store_disk(p, prof)
+                self._insert(p, prof)
+                by_path[p] = prof
+        return [by_path[p] for p in paths]
 
 
 class _FragmentANIMixin:
@@ -146,7 +190,7 @@ class _FragmentANIMixin:
             # fetched deduplicated before pair assembly
             unique = list(dict.fromkeys(p for pair in pairs for p in pair))
             with self.store.reserve(len(unique)):
-                by_path = {p: self.store.get(p) for p in unique}
+                by_path = dict(zip(unique, self.store.get_many(unique)))
             profs = [(by_path[a], by_path[b]) for a, b in pairs]
         with timing.stage("fragment-ani"):
             results = fragment_ani.bidirectional_ani_batch(
@@ -222,7 +266,7 @@ class SkaniPreclusterer(PreclusterBackend):
                     n)
         with timing.stage("profile-genomes"):
             with self.store.reserve(n):
-                profiles = [self.store.get(p) for p in genome_paths]
+                profiles = self.store.get_many(genome_paths)
 
         # Marker matrix: pad each genome's marker sketch to a common width.
         m = max(max((p.markers.shape[0] for p in profiles), default=1), 1)

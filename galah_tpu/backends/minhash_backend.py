@@ -10,7 +10,7 @@ O(N^2) loop.
 from __future__ import annotations
 
 import logging
-from typing import Dict, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from galah_tpu.backends.base import PreclusterBackend
 from galah_tpu.cluster.cache import PairDistanceCache
@@ -75,7 +75,7 @@ class SketchStore:
         self._sketches[path] = s
         return s
 
-    def put_from_genomes(self, items) -> None:
+    def put_from_genomes(self, items) -> "List[MinHashSketch]":
         """Batch-sketch [(path, genome)] — grouped device dispatches
         (ops/minhash.sketch_genomes_device_batch), bit-identical results."""
         sketches = sketch_genomes_device_batch(
@@ -85,6 +85,7 @@ class SketchStore:
             self.cache.store(p, "minhash", self._params(),
                              {"hashes": s.hashes})
             self._sketches[p] = s
+        return sketches
 
     def get(self, path: str) -> MinHashSketch:
         s = self.get_cached(path)
@@ -131,9 +132,10 @@ class MinHashPreclusterer(PreclusterBackend):
             # each dispatch).
             for buf in iter_batches(
                     miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET):
-                self.store.put_from_genomes(buf)
-            sketches = [by_path.get(p) or self.store.get(p)
-                        for p in genome_paths]
+                for (p, _), s in zip(buf,
+                                     self.store.put_from_genomes(buf)):
+                    by_path[p] = s
+            sketches = [by_path[p] for p in genome_paths]
             mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
         logger.info("Computing tiled all-pairs Mash ANI ..")
         with timing.stage("pairwise-minhash"):

@@ -129,8 +129,15 @@ class GenomeProfile:
         return wins
 
 
+# Half the generic hashing.BATCH_BUDGET: profile batches download the
+# FULL positional hash rows (8 bytes/position) to host, unlike the
+# sketch paths that reduce on device first, so the per-dispatch host
+# array is kept to ~128 MB.
+PROFILE_BATCH_BUDGET = hashing.BATCH_BUDGET // 2
+
+
 def positional_hashes(genome: Genome, k: int,
-                      chunk: int = 1 << 23) -> np.ndarray:
+                      chunk: int = hashing.DEFAULT_CHUNK) -> np.ndarray:
     """All canonical k-mer hashes of a genome in genome order (device)."""
     n = genome.codes.shape[0]
     if n < k:
@@ -140,6 +147,50 @@ def positional_hashes(genome: Genome, k: int,
             genome.codes, genome.contig_offsets, k=k, chunk=chunk):
         out[pos: pos + n_new] = np.asarray(h)[:n_new]
     return out
+
+
+def positional_hashes_batch(genomes, k: int,
+                            budget: int = PROFILE_BATCH_BUDGET) -> list:
+    """Batch twin of positional_hashes: grouped one-dispatch hashing of
+    many genomes (same grouping as ops/minhash batch sketching), each
+    entry bit-identical to positional_hashes(genome, k)."""
+    out = [None] * len(genomes)
+    skipped, group_iter = hashing.iter_genome_groups(
+        genomes, budget=budget, max_len=hashing.DEFAULT_CHUNK)
+    for i in skipped:
+        out[i] = positional_hashes(genomes[i], k)
+    for chunk_idxs, packed, ambits, offs in group_iter:
+        import jax.numpy as jnp
+
+        h = np.asarray(hashing.canonical_kmer_hashes_batch_jit(
+            jnp.asarray(packed), jnp.asarray(ambits), jnp.asarray(offs),
+            k=k))
+        for row, gi in enumerate(chunk_idxs):
+            n = genomes[gi].codes.shape[0]
+            if n < k:
+                out[gi] = np.zeros(0, dtype=np.uint64)
+            else:
+                out[gi] = h[row, : n - k + 1].copy()
+    return out
+
+
+def _profile_from_flat(path: str, flat: np.ndarray, k: int, fraglen: int,
+                       subsample_c: int) -> GenomeProfile:
+    """Host post-pass shared by single and batched profile builds:
+    FracMinHash subsample mask, distinct set, marker slice."""
+    if not 1 <= subsample_c <= MARKER_C:
+        raise ValueError(
+            f"subsample_c must be in [1, {MARKER_C}], got {subsample_c}")
+    if subsample_c > 1:
+        cut = np.uint64((1 << 64) // subsample_c)
+        flat = np.where(flat < cut, flat, np.uint64(SENTINEL))
+    valid = flat[flat != np.uint64(SENTINEL)]
+    ref_set = np.unique(valid)
+    markers = ref_set[ref_set < np.uint64((1 << 64) // MARKER_C)]
+    return GenomeProfile(
+        path=path, k=k, fraglen=fraglen,
+        flat_hashes=flat, ref_set=ref_set, markers=markers,
+        subsample_c=subsample_c)
 
 
 def build_profile(genome: Genome, k: int, fraglen: int,
@@ -157,20 +208,20 @@ def build_profile(genome: Genome, k: int, fraglen: int,
     subset of any c <= MARKER_C selection, so screening semantics are
     unchanged.
     """
-    if not 1 <= subsample_c <= MARKER_C:
-        raise ValueError(
-            f"subsample_c must be in [1, {MARKER_C}], got {subsample_c}")
-    flat = positional_hashes(genome, k)
-    if subsample_c > 1:
-        cut = np.uint64((1 << 64) // subsample_c)
-        flat = np.where(flat < cut, flat, np.uint64(SENTINEL))
-    valid = flat[flat != np.uint64(SENTINEL)]
-    ref_set = np.unique(valid)
-    markers = ref_set[ref_set < np.uint64((1 << 64) // MARKER_C)]
-    return GenomeProfile(
-        path=genome.path, k=k, fraglen=fraglen,
-        flat_hashes=flat, ref_set=ref_set, markers=markers,
-        subsample_c=subsample_c)
+    return _profile_from_flat(genome.path, positional_hashes(genome, k),
+                              k, fraglen, subsample_c)
+
+
+def build_profiles_batch(genomes, k: int, fraglen: int,
+                         subsample_c: int = 1) -> list:
+    """Batch twin of build_profile: one hash dispatch per genome group
+    instead of per genome (reference analog: skani's fastx_to_sketches
+    over all files, src/skani.rs:46)."""
+    flats = positional_hashes_batch(genomes, k)
+    return [
+        _profile_from_flat(g.path, flat, k, fraglen, subsample_c)
+        for g, flat in zip(genomes, flats)
+    ]
 
 
 def _bucket_pow2(n: int, floor: int = 1 << 12) -> int:

@@ -356,6 +356,15 @@ def canonical_kmer_hashes_batch(packed, ambits, offsets, k, seed, algo):
     )(packed, ambits, offsets)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "seed", "algo"))
+def canonical_kmer_hashes_batch_jit(packed, ambits, offsets, k=21,
+                                    seed=0, algo="murmur3"):
+    """Jitted standalone wrapper of canonical_kmer_hashes_batch for
+    callers that want the raw positional hash rows (fragment profiles)."""
+    return canonical_kmer_hashes_batch(packed, ambits, offsets, k, seed,
+                                       algo)
+
+
 def iter_genome_groups(genomes, budget, max_len, quantum=1 << 16):
     """Host-side grouping for batched sketching: bucket genomes by
     quantum-padded length (+ pow2 interior-offset width, bounding compile

@@ -71,3 +71,64 @@ def test_mixed_shape_buckets(profiles, ref_data):
     batched = fragment_ani.directed_ani_batch(queries)
     for (q, r), got in zip(queries, batched):
         assert got == fragment_ani.directed_ani(q, r)
+
+
+def test_build_profiles_batch_matches_single(tmp_path):
+    """build_profiles_batch is bit-identical to per-genome build_profile
+    (positional hashes, distinct set, markers), with and without
+    FracMinHash subsampling."""
+    import numpy as np
+
+    from galah_tpu.io import read_genome
+    from galah_tpu.ops import fragment_ani
+
+    rng = np.random.default_rng(17)
+    genomes = []
+    for i, seq_len in enumerate([200, 5000, 70_000]):
+        seq = "".join(rng.choice(list("ACGT"), size=seq_len))
+        p = tmp_path / f"p{i}.fna"
+        p.write_text(f">a\n{seq[: seq_len // 2]}N{seq[seq_len // 2:]}\n"
+                     f">b\n{seq[:60]}\n")
+        genomes.append(read_genome(str(p)))
+
+    for c in (1, 16):
+        batch = fragment_ani.build_profiles_batch(
+            genomes, k=15, fraglen=3000, subsample_c=c)
+        for g, prof in zip(genomes, batch):
+            single = fragment_ani.build_profile(
+                g, k=15, fraglen=3000, subsample_c=c)
+            np.testing.assert_array_equal(single.flat_hashes,
+                                          prof.flat_hashes)
+            np.testing.assert_array_equal(single.ref_set, prof.ref_set)
+            np.testing.assert_array_equal(single.markers, prof.markers)
+
+
+def test_profile_store_get_many(tmp_path):
+    """get_many returns the same profiles as repeated get(), fills the
+    LRU, and survives mixed memory/disk/miss states."""
+    import numpy as np
+
+    from galah_tpu.backends.fragment_backend import ProfileStore
+    from galah_tpu.io import diskcache
+
+    rng = np.random.default_rng(23)
+    paths = []
+    for i in range(4):
+        seq = "".join(rng.choice(list("ACGT"), size=2000 + 100 * i))
+        p = tmp_path / f"s{i}.fna"
+        p.write_text(f">c\n{seq}\n")
+        paths.append(str(p))
+
+    cache = diskcache.CacheDir(str(tmp_path / "cache"))
+    store = ProfileStore(k=15, fraglen=3000, cache=cache)
+    store.get(paths[0])          # memory hit
+    profs = store.get_many(paths)
+    for p, prof in zip(paths, profs):
+        ref = store.get(p)
+        np.testing.assert_array_equal(ref.flat_hashes, prof.flat_hashes)
+
+    # disk-hit path: a fresh store over the same cache dir
+    store2 = ProfileStore(k=15, fraglen=3000, cache=cache)
+    profs2 = store2.get_many(paths)
+    for a, b in zip(profs, profs2):
+        np.testing.assert_array_equal(a.ref_set, b.ref_set)
