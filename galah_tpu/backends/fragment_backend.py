@@ -151,14 +151,28 @@ class ProfileStore:
                 by_path[p] = prof
             else:
                 misses.append(p)
-        for buf in iter_batches(
-                iter_prefetched(misses, read_genome),
-                lambda g: g.codes.shape[0],
-                budget=fragment_ani.PROFILE_BATCH_BUDGET):
-            profs = fragment_ani.build_profiles_batch(
-                [g for _, g in buf], k=self.k, fraglen=self.fraglen,
-                subsample_c=self.subsample_c)
-            for (p, _), prof in zip(buf, profs):
+        from galah_tpu.ops.hashing import device_transfer_bound
+
+        if device_transfer_bound():
+            # TPU backend: grouped batch dispatches amortize round trips.
+            for buf in iter_batches(
+                    iter_prefetched(misses, read_genome),
+                    lambda g: g.codes.shape[0],
+                    budget=fragment_ani.PROFILE_BATCH_BUDGET):
+                profs = fragment_ani.build_profiles_batch(
+                    [g for _, g in buf], k=self.k, fraglen=self.fraglen,
+                    subsample_c=self.subsample_c)
+                for (p, _), prof in zip(buf, profs):
+                    self._store_disk(p, prof)
+                    self._insert(p, prof)
+                    by_path[p] = prof
+        else:
+            # CPU backend: per-genome chunks are cache-friendlier
+            # (measured 3x faster than the big batched arrays).
+            for p, genome in iter_prefetched(misses, read_genome):
+                prof = fragment_ani.build_profile(
+                    genome, k=self.k, fraglen=self.fraglen,
+                    subsample_c=self.subsample_c)
                 self._store_disk(p, prof)
                 self._insert(p, prof)
                 by_path[p] = prof

@@ -18,6 +18,7 @@ from galah_tpu.config import Defaults
 from galah_tpu.io import diskcache
 from galah_tpu.io.diskcache import CacheDir
 from galah_tpu.io.fasta import read_genome
+from galah_tpu.ops import hashing
 from galah_tpu.ops.minhash import (
     BATCH_BUDGET,
     sketch_genome_device,
@@ -127,14 +128,22 @@ class MinHashPreclusterer(PreclusterBackend):
             # the device sketches the previous genome
             by_path, miss_iter = probe_and_prefetch(
                 genome_paths, self.store.get_cached, read_genome)
-            # Batch cache misses into grouped device dispatches (the
-            # prefetch look-ahead hides at most `depth` ingestions behind
-            # each dispatch).
-            for buf in iter_batches(
-                    miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET):
-                for (p, _), s in zip(buf,
-                                     self.store.put_from_genomes(buf)):
-                    by_path[p] = s
+            if hashing.device_transfer_bound():
+                # Batch cache misses into grouped device dispatches (the
+                # prefetch look-ahead hides at most `depth` ingestions
+                # behind each dispatch) — dispatch round trips dominate
+                # on a TPU backend.
+                for buf in iter_batches(
+                        miss_iter, lambda g: g.codes.shape[0],
+                        BATCH_BUDGET):
+                    for (p, _), s in zip(
+                            buf, self.store.put_from_genomes(buf)):
+                        by_path[p] = s
+            else:
+                # CPU backend: per-genome chunks are cache-friendlier
+                # and there is no transfer to amortize.
+                for p, genome in miss_iter:
+                    by_path[p] = self.store.put_from_genome(p, genome)
             sketches = [by_path[p] for p in genome_paths]
             mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
         logger.info("Computing tiled all-pairs Mash ANI ..")

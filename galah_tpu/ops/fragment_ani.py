@@ -174,13 +174,17 @@ def positional_hashes_batch(genomes, k: int,
     return out
 
 
+def _check_subsample(subsample_c: int) -> None:
+    if not 1 <= subsample_c <= MARKER_C:
+        raise ValueError(
+            f"subsample_c must be in [1, {MARKER_C}], got {subsample_c}")
+
+
 def _profile_from_flat(path: str, flat: np.ndarray, k: int, fraglen: int,
                        subsample_c: int) -> GenomeProfile:
     """Host post-pass shared by single and batched profile builds:
     FracMinHash subsample mask, distinct set, marker slice."""
-    if not 1 <= subsample_c <= MARKER_C:
-        raise ValueError(
-            f"subsample_c must be in [1, {MARKER_C}], got {subsample_c}")
+    _check_subsample(subsample_c)
     if subsample_c > 1:
         cut = np.uint64((1 << 64) // subsample_c)
         flat = np.where(flat < cut, flat, np.uint64(SENTINEL))
@@ -208,6 +212,7 @@ def build_profile(genome: Genome, k: int, fraglen: int,
     subset of any c <= MARKER_C selection, so screening semantics are
     unchanged.
     """
+    _check_subsample(subsample_c)  # fail before any device hashing
     return _profile_from_flat(genome.path, positional_hashes(genome, k),
                               k, fraglen, subsample_c)
 
@@ -217,6 +222,7 @@ def build_profiles_batch(genomes, k: int, fraglen: int,
     """Batch twin of build_profile: one hash dispatch per genome group
     instead of per genome (reference analog: skani's fastx_to_sketches
     over all files, src/skani.rs:46)."""
+    _check_subsample(subsample_c)  # fail before any device hashing
     flats = positional_hashes_batch(genomes, k)
     return [
         _profile_from_flat(g.path, flat, k, fraglen, subsample_c)

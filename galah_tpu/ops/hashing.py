@@ -15,6 +15,7 @@ reverse-complement) k-mer ASCII bytes, murmur3 x64_128 seed 0, low u64.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,22 @@ DEFAULT_CHUNK = 1 << 23
 BATCH_BUDGET = 1 << 25
 
 _ASCII = jnp.array([65, 67, 71, 84], dtype=jnp.uint8)  # ACGT
+
+
+def device_transfer_bound() -> bool:
+    """True when host->device transfer + dispatch round trips dominate
+    small ops — i.e. on a real TPU backend (tunneled or PCIe). Gates the
+    packed-upload and batched-grouping policies: on the CPU backend both
+    are pure overhead (data is already in host memory, and the big
+    batched arrays lose cache locality — measured 3x slower profile
+    builds). Override with GALAH_PACKED_TRANSFER=0/1 for testing."""
+    env = os.environ.get("GALAH_PACKED_TRANSFER")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend probing never raises
+        return False
 
 
 def _rotl64(x: jax.Array, r: int) -> jax.Array:
@@ -465,6 +482,7 @@ def iter_chunk_hashes(codes, contig_offsets, k: int, chunk: int,
     offs_pad[: offs.shape[0]] = offs
     joffs = jnp.asarray(offs_pad.astype(np.int32))
 
+    packed_transfer = device_transfer_bound()
     step = chunk - (k - 1)
     pos = 0
     total = max(n - k + 1, 0)
@@ -472,13 +490,20 @@ def iter_chunk_hashes(codes, contig_offsets, k: int, chunk: int,
         end = min(pos + chunk, n)
         c = np.full(chunk, 255, dtype=np.uint8)
         c[: end - pos] = codes[pos:end]
-        # Pack on host: 4 bases/byte + 1-bit ambiguity mask (chunk is a
-        # 64 Ki multiple, so always divisible by 8). Cuts host->device
-        # bytes 3.6x — the dominant cost through a tunneled TPU.
-        packed, ambits = pack_codes_host(c)
-        hashes = canonical_kmer_hashes_chunk_packed(
-            jnp.asarray(packed), jnp.asarray(ambits), joffs,
-            jnp.int32(pos), k=k, seed=seed, algo=algo)
+        if packed_transfer:
+            # Pack on host: 4 bases/byte + 1-bit ambiguity mask (chunk
+            # is a 64 Ki multiple, so always divisible by 8). Cuts
+            # host->device bytes 3.6x — the dominant cost through a
+            # tunneled TPU. On CPU the unpack is pure overhead, so the
+            # unpacked twin runs instead (bit-identical).
+            packed, ambits = pack_codes_host(c)
+            hashes = canonical_kmer_hashes_chunk_packed(
+                jnp.asarray(packed), jnp.asarray(ambits), joffs,
+                jnp.int32(pos), k=k, seed=seed, algo=algo)
+        else:
+            hashes = canonical_kmer_hashes_chunk(
+                jnp.asarray(c), joffs, jnp.int32(pos), k=k, seed=seed,
+                algo=algo)
         n_new = min(total - pos, chunk - k + 1) if total else 0
         yield hashes, pos, n_new
         pos += step

@@ -32,6 +32,8 @@ def main() -> None:
     ap.add_argument("--hash", default="murmur3",
                     choices=("murmur3", "tpufast"))
     ap.add_argument("--skip-rung1", action="store_true")
+    ap.add_argument("--ani-subsample", type=int, default=1,
+                    help="FracMinHash c for the exact-ANI stage")
     args = ap.parse_args()
 
     if args.cpu:
@@ -70,6 +72,7 @@ def main() -> None:
         "min_aligned_fraction": 15.0, "fragment_length": 3000,
         "precluster_method": "finch", "cluster_method": "skani",
         "threads": 4, "hash_algorithm": args.hash,
+        "ani_subsample": args.ani_subsample,
     }
 
     if not args.skip_rung1:

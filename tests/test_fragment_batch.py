@@ -132,3 +132,32 @@ def test_profile_store_get_many(tmp_path):
     profs2 = store2.get_many(paths)
     for a, b in zip(profs, profs2):
         np.testing.assert_array_equal(a.ref_set, b.ref_set)
+
+
+def test_profile_store_get_many_batched_branch(tmp_path, monkeypatch):
+    """GALAH_PACKED_TRANSFER=1 forces the TPU-policy batched branch of
+    get_many; results must match the per-genome branch bit-for-bit."""
+    import numpy as np
+
+    from galah_tpu.backends.fragment_backend import ProfileStore
+    from galah_tpu.io import diskcache
+
+    rng = np.random.default_rng(29)
+    paths = []
+    for i in range(3):
+        seq = "".join(rng.choice(list("ACGT"), size=3000 + 37 * i))
+        p = tmp_path / f"b{i}.fna"
+        p.write_text(f">c\n{seq}\n")
+        paths.append(str(p))
+
+    store_cpu = ProfileStore(
+        k=15, fraglen=3000, cache=diskcache.CacheDir(str(tmp_path / "c1")))
+    plain = store_cpu.get_many(paths)
+
+    monkeypatch.setenv("GALAH_PACKED_TRANSFER", "1")
+    store_tpu = ProfileStore(
+        k=15, fraglen=3000, cache=diskcache.CacheDir(str(tmp_path / "c2")))
+    batched = store_tpu.get_many(paths)
+    for a, b in zip(plain, batched):
+        np.testing.assert_array_equal(a.flat_hashes, b.flat_hashes)
+        np.testing.assert_array_equal(a.ref_set, b.ref_set)

@@ -187,3 +187,30 @@ def test_batch_sketch_tiny_budget_groups(tmp_path):
     b = sketch_genomes_device_batch(genomes, sketch_size=32)
     for x, y in zip(a, b):
         np.testing.assert_array_equal(x.hashes, y.hashes)
+
+
+def test_preclusterer_batched_branch_matches(tmp_path, monkeypatch):
+    """The backend's TPU-policy batched sketch branch produces the same
+    pair cache as the per-genome CPU branch."""
+    from galah_tpu.backends.minhash_backend import MinHashPreclusterer
+    from galah_tpu.io.diskcache import CacheDir
+
+    rng = np.random.default_rng(31)
+    base = rng.choice(list("ACGT"), size=40_000)
+    paths = []
+    for i in range(4):
+        seq = base.copy()
+        if i >= 2:  # second family
+            sites = rng.random(seq.shape[0]) < 0.03
+            repl = rng.choice(list("ACGT"), size=int(sites.sum()))
+            seq[sites] = repl
+        p = tmp_path / f"m{i}.fna"
+        p.write_text(">c\n" + "".join(seq) + "\n")
+        paths.append(str(p))
+
+    plain = MinHashPreclusterer(
+        0.95, cache=CacheDir(str(tmp_path / "c1"))).distances(paths)
+    monkeypatch.setenv("GALAH_PACKED_TRANSFER", "1")
+    batched = MinHashPreclusterer(
+        0.95, cache=CacheDir(str(tmp_path / "c2"))).distances(paths)
+    assert dict(plain.items()) == dict(batched.items())

@@ -49,3 +49,21 @@ def test_exception_surfaces_at_failing_item():
 
 def test_empty():
     assert list(iter_prefetched([], lambda p: p)) == []
+
+
+def test_iter_batches_budget_and_cap():
+    from galah_tpu.io.prefetch import iter_batches
+
+    items = [(f"p{i}", i) for i in range(10)]
+    # budget 5 with sizes 0..9: greedy accumulate-until-total>=budget
+    out = list(iter_batches(iter(items), lambda v: v, budget=5))
+    assert [len(b) for b in out] == [4, 2, 1, 1, 1, 1]
+    assert [v for b in out for _, v in b] == list(range(10))
+
+    # max_items cap
+    out = list(iter_batches(iter(items), lambda v: 0, budget=10**9,
+                            max_items=4))
+    assert [len(b) for b in out] == [4, 4, 2]
+
+    # empty stream
+    assert list(iter_batches(iter([]), lambda v: v, budget=1)) == []
