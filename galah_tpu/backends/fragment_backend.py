@@ -135,7 +135,7 @@ class ProfileStore:
         prefetch pool and hashed in grouped batch dispatches
         (ops/fragment_ani.build_profiles_batch) instead of one dispatch
         per genome."""
-        from galah_tpu.io.prefetch import iter_batches, iter_prefetched
+        from galah_tpu.io.prefetch import iter_prefetched, process_stream
 
         by_path: "dict[str, GenomeProfile]" = {}
         misses = []
@@ -153,29 +153,20 @@ class ProfileStore:
                 misses.append(p)
         from galah_tpu.ops.hashing import device_transfer_bound
 
-        if device_transfer_bound():
-            # TPU backend: grouped batch dispatches amortize round trips.
-            for buf in iter_batches(
-                    iter_prefetched(misses, read_genome),
-                    lambda g: g.codes.shape[0],
-                    budget=fragment_ani.PROFILE_BATCH_BUDGET):
-                profs = fragment_ani.build_profiles_batch(
+        for p, prof in process_stream(
+                iter_prefetched(misses, read_genome),
+                lambda g: g.codes.shape[0],
+                fragment_ani.PROFILE_BATCH_BUDGET,
+                lambda buf: fragment_ani.build_profiles_batch(
                     [g for _, g in buf], k=self.k, fraglen=self.fraglen,
-                    subsample_c=self.subsample_c)
-                for (p, _), prof in zip(buf, profs):
-                    self._store_disk(p, prof)
-                    self._insert(p, prof)
-                    by_path[p] = prof
-        else:
-            # CPU backend: per-genome chunks are cache-friendlier
-            # (measured 3x faster than the big batched arrays).
-            for p, genome in iter_prefetched(misses, read_genome):
-                prof = fragment_ani.build_profile(
-                    genome, k=self.k, fraglen=self.fraglen,
-                    subsample_c=self.subsample_c)
-                self._store_disk(p, prof)
-                self._insert(p, prof)
-                by_path[p] = prof
+                    subsample_c=self.subsample_c),
+                lambda _path, g: fragment_ani.build_profile(
+                    g, k=self.k, fraglen=self.fraglen,
+                    subsample_c=self.subsample_c),
+                batched=device_transfer_bound()):
+            self._store_disk(p, prof)
+            self._insert(p, prof)
+            by_path[p] = prof
         return [by_path[p] for p in paths]
 
 

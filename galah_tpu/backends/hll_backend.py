@@ -64,33 +64,23 @@ class HLLPreclusterer(PreclusterBackend):
                 genome_paths, probe, read_genome)
             for path, row in hits.items():
                 regs[index[path]] = row
-            from galah_tpu.io.prefetch import iter_batches
+            from galah_tpu.io.prefetch import process_stream
             from galah_tpu.ops.hashing import (
                 BATCH_BUDGET,
                 device_transfer_bound,
             )
 
-            if device_transfer_bound():
-                # Batch cache misses into grouped one-dispatch sketches
-                # (dispatch round trips dominate on a TPU backend).
-                for buf in iter_batches(
-                        miss_iter, lambda g: g.codes.shape[0],
-                        BATCH_BUDGET):
-                    rows = hll.hll_sketch_genomes_batch(
+            for path, row in process_stream(
+                    miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
+                    lambda buf: hll.hll_sketch_genomes_batch(
                         [g for _, g in buf], p=self.p, k=self.k,
-                        seed=self.seed, algo=self.algo)
-                    for (path, _), row in zip(buf, rows):
-                        regs[index[path]] = row
-                        self.cache.store(path, "hll", params,
-                                         {"regs": row})
-            else:
-                # CPU backend: per-genome chunks are cache-friendlier.
-                for path, genome in miss_iter:
-                    row = hll.hll_sketch_genome(
-                        genome, p=self.p, k=self.k, seed=self.seed,
-                        algo=self.algo)
-                    regs[index[path]] = row
-                    self.cache.store(path, "hll", params, {"regs": row})
+                        seed=self.seed, algo=self.algo),
+                    lambda _path, g: hll.hll_sketch_genome(
+                        g, p=self.p, k=self.k, seed=self.seed,
+                        algo=self.algo),
+                    batched=device_transfer_bound()):
+                regs[index[path]] = row
+                self.cache.store(path, "hll", params, {"regs": row})
 
         logger.info("Computing tiled all-pairs HLL ANI ..")
         with timing.stage("pairwise-hll"):

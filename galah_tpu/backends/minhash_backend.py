@@ -120,30 +120,20 @@ class MinHashPreclusterer(PreclusterBackend):
             len(genome_paths))
         with timing.stage("sketch-minhash"):
             from galah_tpu.io.prefetch import (
-                iter_batches,
                 probe_and_prefetch,
+                process_stream,
             )
 
             # cache misses: ingestion prefetched on host threads while
             # the device sketches the previous genome
             by_path, miss_iter = probe_and_prefetch(
                 genome_paths, self.store.get_cached, read_genome)
-            if hashing.device_transfer_bound():
-                # Batch cache misses into grouped device dispatches (the
-                # prefetch look-ahead hides at most `depth` ingestions
-                # behind each dispatch) — dispatch round trips dominate
-                # on a TPU backend.
-                for buf in iter_batches(
-                        miss_iter, lambda g: g.codes.shape[0],
-                        BATCH_BUDGET):
-                    for (p, _), s in zip(
-                            buf, self.store.put_from_genomes(buf)):
-                        by_path[p] = s
-            else:
-                # CPU backend: per-genome chunks are cache-friendlier
-                # and there is no transfer to amortize.
-                for p, genome in miss_iter:
-                    by_path[p] = self.store.put_from_genome(p, genome)
+            for p, s in process_stream(
+                    miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
+                    self.store.put_from_genomes,
+                    self.store.put_from_genome,
+                    batched=hashing.device_transfer_bound()):
+                by_path[p] = s
             sketches = [by_path[p] for p in genome_paths]
             mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
         logger.info("Computing tiled all-pairs Mash ANI ..")

@@ -65,6 +65,29 @@ def iter_batches(
         yield buf
 
 
+def process_stream(
+    items: Iterator[Tuple[str, T]],
+    size_fn: Callable[[T], int],
+    budget: int,
+    batch_fn: Callable[[list], list],
+    single_fn: Callable[[str, T], V],
+    batched: bool,
+) -> Iterator[Tuple[str, V]]:
+    """Yield (path, result) for a (path, item) stream — through grouped
+    `batch_fn(buffer) -> [result]` calls when `batched` (TPU backends,
+    where dispatch round trips dominate), else per-item
+    `single_fn(path, item)` (CPU backends, where per-genome chunks are
+    cache-friendlier). The one gate/batch/store shape shared by the
+    three sketching backends."""
+    if batched:
+        for buf in iter_batches(items, size_fn, budget):
+            for (p, _), r in zip(buf, batch_fn(buf)):
+                yield p, r
+    else:
+        for p, it in items:
+            yield p, single_fn(p, it)
+
+
 def iter_prefetched(
     paths: Sequence[str],
     load_fn: Callable[[str], T],

@@ -1,0 +1,101 @@
+"""Measure the sketching-stage variants on the live TPU.
+
+Run when the tunnel is healthy. Answers, with captured numbers:
+  1. packed vs unpacked chunk upload (is the 3.6x byte cut visible?);
+  2. hash-only vs hash+bottom-k fold (is the u64 sort the bottleneck?);
+  3. per-genome vs grouped batch sketching on real MAGs (dispatch
+     round-trip amortization).
+
+Timings force host materialization — through the tunnel,
+block_until_ready does not actually block.
+"""
+
+import glob
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def _timeit(fn, repeats=3):
+    fn()  # compile/warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from galah_tpu.ops import hashing
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+
+    C = 1 << 21  # 2 Mi bases — one mid-size MAG chunk
+    rng = np.random.default_rng(0)
+    codes = rng.integers(0, 4, size=C).astype(np.uint8)
+    offs = jnp.asarray(np.full(1, 2**31 - 1, dtype=np.int32))
+    packed, ambits = hashing.pack_codes_host(codes)
+
+    for algo in ("murmur3", "tpufast"):
+        t_unpacked = _timeit(lambda: np.asarray(
+            hashing.canonical_kmer_hashes_chunk(
+                jnp.asarray(codes), offs, jnp.int32(0), k=21,
+                algo=algo))[0])
+        t_packed = _timeit(lambda: np.asarray(
+            hashing.canonical_kmer_hashes_chunk_packed(
+                jnp.asarray(packed), jnp.asarray(ambits), offs,
+                jnp.int32(0), k=21, algo=algo))[0])
+        print(f"{algo}: unpacked {C / t_unpacked / 1e6:.1f} Mpos/s, "
+              f"packed {C / t_packed / 1e6:.1f} Mpos/s "
+              f"(upload {C} vs {C // 4 + C // 8} B)", flush=True)
+
+    # hash+fold vs hash-only (device-resident input isolates compute)
+    dev_packed = jax.device_put(jnp.asarray(packed))
+    dev_ambits = jax.device_put(jnp.asarray(ambits))
+
+    def hash_only():
+        h = hashing.canonical_kmer_hashes_chunk_packed(
+            dev_packed, dev_ambits, offs, jnp.int32(0), k=21)
+        return np.asarray(h[:4])
+
+    def hash_fold():
+        h = hashing.canonical_kmer_hashes_chunk_packed(
+            dev_packed, dev_ambits, offs, jnp.int32(0), k=21)
+        running = jnp.full((1000,), hashing.HASH_SENTINEL)
+        return np.asarray(hashing.bottom_k_update(running, h, 1000)[:4])
+
+    t_h = _timeit(hash_only)
+    t_hf = _timeit(hash_fold)
+    print(f"hash-only {C / t_h / 1e6:.1f} Mpos/s, hash+bottom-k fold "
+          f"{C / t_hf / 1e6:.1f} Mpos/s (sort overhead "
+          f"{(t_hf - t_h) / t_hf * 100:.0f}%)", flush=True)
+
+    # per-genome vs batch on real MAGs
+    from galah_tpu.io.fasta import read_genome
+    from galah_tpu.ops.minhash import (
+        sketch_genome_device,
+        sketch_genomes_device_batch,
+    )
+
+    paths = sorted(glob.glob(
+        "/root/reference/tests/data/abisko4/*.fna"))[:6]
+    genomes = [read_genome(p) for p in paths]
+    total_bp = sum(int(g.codes.shape[0]) for g in genomes)
+    t_single = _timeit(
+        lambda: [sketch_genome_device(g) for g in genomes], repeats=2)
+    t_batch = _timeit(
+        lambda: sketch_genomes_device_batch(genomes), repeats=2)
+    print(f"6 real MAGs ({total_bp / 1e6:.1f} Mbp): per-genome "
+          f"{total_bp / t_single / 1e6:.1f} Mbp/s, batch "
+          f"{total_bp / t_batch / 1e6:.1f} Mbp/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

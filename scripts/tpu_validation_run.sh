@@ -25,8 +25,15 @@ timeout 2400 python -m pytest tests/test_tpu_hw.py -q >> "$LOG" 2>&1
 echo "--- bench.py ---" >> "$LOG"
 timeout 1800 python bench.py >> "$LOG" 2>/dev/null
 
-echo "--- ladder (tpu, c=16) ---" >> "$LOG"
+echo "--- sketch variants ---" >> "$LOG"
+timeout 1200 python scripts/bench_sketch_variants.py >> "$LOG" 2>/dev/null
+
+echo "--- pair-stats kernel variants ---" >> "$LOG"
+timeout 1200 python scripts/bench_kernel_variants.py >> "$LOG" 2>/dev/null
+
+echo "--- ladder (tpu, tpufast c=16) ---" >> "$LOG"
 timeout 2400 python scripts/ladder_bench.py --n 100 \
-  --genome-len 300000 --skip-rung1 >> "$LOG" 2>/dev/null
+  --genome-len 300000 --skip-rung1 --hash tpufast \
+  --ani-subsample 16 >> "$LOG" 2>/dev/null
 
 echo "=== done $(date -u) ===" >> "$LOG"
