@@ -315,7 +315,7 @@ def canonical_kmer_hashes_chunk(
     """Hash canonical k-mers from unpacked 1-byte-per-base codes.
 
     See _hash_core for semantics. Production chunk iteration uses the
-    packed twin below (3.6x less host->device transfer); this entry point
+    packed twin below (2.7x less host->device transfer); this entry point
     stays for callers holding codes already on device.
     """
     cs = jnp.where(codes == jnp.uint8(255), jnp.uint8(0), codes)
@@ -350,7 +350,7 @@ def canonical_kmer_hashes_chunk_packed(
 ) -> jax.Array:
     """Packed-transfer twin of canonical_kmer_hashes_chunk, bit-identical.
 
-    The host packs 4 bases/byte plus a 1-bit/base ambiguity mask (0.28
+    The host packs 4 bases/byte plus a 1-bit/base ambiguity mask (0.375
     bytes/base vs 1), and the device unpacks with shift/mask chains —
     host->device bytes are the scarce resource on a tunneled TPU
     (~30 MiB/s), and the unpack is a handful of fused vector ops.
@@ -493,7 +493,7 @@ def iter_chunk_hashes(codes, contig_offsets, k: int, chunk: int,
         if packed_transfer:
             # Pack on host: 4 bases/byte + 1-bit ambiguity mask (chunk
             # is a 64 Ki multiple, so always divisible by 8). Cuts
-            # host->device bytes 3.6x — the dominant cost through a
+            # host->device bytes 2.7x — the dominant cost through a
             # tunneled TPU. On CPU the unpack is pure overhead, so the
             # unpacked twin runs instead (bit-identical).
             packed, ambits = pack_codes_host(c)

@@ -1,7 +1,7 @@
 """Measure the sketching-stage variants on the live TPU.
 
 Run when the tunnel is healthy. Answers, with captured numbers:
-  1. packed vs unpacked chunk upload (is the 3.6x byte cut visible?);
+  1. packed vs unpacked chunk upload (is the 2.7x byte cut visible?);
   2. hash-only vs hash+bottom-k fold (is the u64 sort the bottleneck?);
   3. per-genome vs grouped batch sketching on real MAGs (dispatch
      round-trip amortization).
@@ -44,14 +44,16 @@ def main():
     packed, ambits = hashing.pack_codes_host(codes)
 
     for algo in ("murmur3", "tpufast"):
+        # materialize only 4 hashes: a full-array download would be a
+        # constant ~16 MiB device->host cost swamping the upload delta
         t_unpacked = _timeit(lambda: np.asarray(
             hashing.canonical_kmer_hashes_chunk(
                 jnp.asarray(codes), offs, jnp.int32(0), k=21,
-                algo=algo))[0])
+                algo=algo)[:4]))
         t_packed = _timeit(lambda: np.asarray(
             hashing.canonical_kmer_hashes_chunk_packed(
                 jnp.asarray(packed), jnp.asarray(ambits), offs,
-                jnp.int32(0), k=21, algo=algo))[0])
+                jnp.int32(0), k=21, algo=algo)[:4]))
         print(f"{algo}: unpacked {C / t_unpacked / 1e6:.1f} Mpos/s, "
               f"packed {C / t_packed / 1e6:.1f} Mpos/s "
               f"(upload {C} vs {C // 4 + C // 8} B)", flush=True)

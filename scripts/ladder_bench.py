@@ -34,6 +34,10 @@ def main() -> None:
     ap.add_argument("--skip-rung1", action="store_true")
     ap.add_argument("--ani-subsample", type=int, default=1,
                     help="FracMinHash c for the exact-ANI stage")
+    ap.add_argument("--rung4", action="store_true",
+                    help="also run the quality-ordered rung: synthetic "
+                         "CheckM2 report + Parks2020_reduced ranking "
+                         "(BASELINE.json rung-4 semantics)")
     args = ap.parse_args()
 
     if args.cpu:
@@ -95,6 +99,31 @@ def main() -> None:
         n_genomes=args.n, genome_len=args.genome_len,
         n_families=n_fam, mut=0.03, seed=11)
     run(f"rung2-synthetic-{args.n}", paths, dict(base_values))
+
+    if args.rung4:
+        # rung 4 semantics: quality-ordered greedy rep selection from a
+        # CheckM2-style quality report (BASELINE.json rung 4 uses 10k
+        # MAGs + CheckM2; this synthesizes the same pipeline shape at
+        # --n genomes so the quality path is measured, not just the
+        # distance path).
+        import numpy as np
+
+        rng = np.random.default_rng(13)
+        qpath = os.path.join(os.path.dirname(paths[0]),
+                             "quality_report.tsv")
+        with open(qpath, "w") as fh:
+            fh.write("Name\tCompleteness\tContamination\n")
+            for p in paths:
+                stem = os.path.splitext(os.path.basename(p))[0]
+                comp = rng.uniform(60.0, 100.0)
+                cont = rng.uniform(0.0, 8.0)
+                fh.write(f"{stem}\t{comp:.2f}\t{cont:.2f}\n")
+        values = dict(base_values)
+        values["checkm2_quality_report"] = qpath
+        values["quality_formula"] = "Parks2020_reduced"
+        values["min_completeness"] = 50.0
+        values["max_contamination"] = 10.0
+        run(f"rung4-quality-{args.n}", paths, values)
 
     print("\n| rung | backend | N | wall (s) | genomes/s | clusters |")
     print("|---|---|---|---|---|---|")

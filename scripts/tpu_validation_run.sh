@@ -26,10 +26,10 @@ echo "--- bench.py ---" >> "$LOG"
 timeout 1800 python bench.py >> "$LOG" 2>/dev/null
 
 echo "--- sketch variants ---" >> "$LOG"
-timeout 1200 python scripts/bench_sketch_variants.py >> "$LOG" 2>/dev/null
+timeout 1200 python scripts/bench_sketch_variants.py >> "$LOG" 2>&1
 
 echo "--- pair-stats kernel variants ---" >> "$LOG"
-timeout 1200 python scripts/bench_kernel_variants.py >> "$LOG" 2>/dev/null
+timeout 1200 python scripts/bench_kernel_variants.py >> "$LOG" 2>&1
 
 echo "--- ladder (tpu, tpufast c=16) ---" >> "$LOG"
 timeout 2400 python scripts/ladder_bench.py --n 100 \
