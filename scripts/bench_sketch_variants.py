@@ -10,7 +10,6 @@ Timings force host materialization — through the tunnel,
 block_until_ready does not actually block.
 """
 
-import glob
 import sys
 import time
 
@@ -79,17 +78,14 @@ def main():
           f"{C / t_hf / 1e6:.1f} Mpos/s (sort overhead "
           f"{(t_hf - t_h) / t_hf * 100:.0f}%)", flush=True)
 
-    # per-genome vs batch on real MAGs
-    from galah_tpu.io.fasta import read_genome
+    # per-genome vs batch on real MAGs (shared bench corpus)
+    from bench import bench_genomes
     from galah_tpu.ops.minhash import (
         sketch_genome_device,
         sketch_genomes_device_batch,
     )
 
-    paths = sorted(glob.glob(
-        "/root/reference/tests/data/abisko4/*.fna"))[:6]
-    genomes = [read_genome(p) for p in paths]
-    total_bp = sum(int(g.codes.shape[0]) for g in genomes)
+    genomes, total_bp = bench_genomes()
     t_single = _timeit(
         lambda: [sketch_genome_device(g) for g in genomes], repeats=2)
     t_batch = _timeit(

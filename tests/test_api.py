@@ -67,3 +67,29 @@ def test_end_to_end_via_api(ref_data):
     clusterer = generate_galah_clusterer(paths, vars(args))
     out = clusterer.cluster()
     assert sorted(sorted(c) for c in out) == [[0, 1, 3], [2]]
+
+
+@pytest.mark.parametrize("pre", ["finch", "dashing", "skani"])
+def test_degenerate_genomes_cluster_alone(tmp_path, pre):
+    """All-N and shorter-than-k genomes survive every precluster backend
+    end-to-end and land in singleton clusters (no reference analog —
+    galah's backends would crash or skip; this build degrades to empty
+    sketches)."""
+    import numpy as np
+
+    from galah_tpu.api import generate_galah_clusterer
+
+    rng = np.random.default_rng(0)
+    seq = "".join(rng.choice(list("ACGT"), size=50_000))
+    paths = []
+    for name, s in [("normal", seq), ("allN", "N" * 5000),
+                    ("short", "ACGTACGT")]:
+        p = tmp_path / f"{name}.fna"
+        p.write_text(f">c\n{s}\n")
+        paths.append(str(p))
+    values = {"ani": 95.0, "precluster_ani": 90.0,
+              "min_aligned_fraction": 15.0, "fragment_length": 3000,
+              "precluster_method": pre, "cluster_method": "skani",
+              "threads": 1}
+    clusters = generate_galah_clusterer(paths, values).cluster()
+    assert sorted(sorted(c) for c in clusters) == [[0], [1], [2]]
