@@ -1,0 +1,131 @@
+/* Merged-bottom-k MinHash pair statistics over a dense sketch matrix.
+ *
+ * Compiled-C twin of the reference's host pair loop (the reference runs
+ * finch's merge walk in compiled Rust on a rayon pool; reference:
+ * src/finch.rs:53-73). This is the honest CPU baseline for bench.py —
+ * the strongest available stand-in given no Rust toolchain in the image
+ * — and doubles as a production CPU fallback for the all-pairs pass.
+ *
+ * Semantics mirror the device extraction exactly: walk the two sorted
+ * sketches in merge order over the smallest `sketch_size` distinct
+ * union hashes (galah_tpu/ops/minhash_np.py::mash_jaccard), then apply
+ * the SAME f64 rational keep-check as ops/pairwise.threshold_pairs'
+ * host pass — common >= j_thr * total with j_thr precomputed by
+ * ani_to_jaccard (no per-pair exp/log in the decision, so borderline
+ * pairs cannot order differently from the device path) — and report
+ * ANI = 1 + ln(2j/(1+j))/k for the survivors. total == 0 pairs (two
+ * empty sketches) are never emitted, matching the device extraction.
+ * Rows are sorted ascending with 0xFFFF..FF sentinel padding; per-row
+ * valid lengths arrive precomputed.
+ */
+
+#include <math.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdlib.h>
+
+typedef struct {
+    const uint64_t *mat;
+    const int64_t *lens;
+    int64_t n, width;
+    int sketch_size, kmer;
+    double j_thr;        /* Jaccard-domain threshold (ani_to_jaccard) */
+    int tid, n_threads;
+    int64_t *out_i, *out_j;
+    double *out_ani;
+    int64_t cap;
+    int64_t *next_slot;  /* shared atomic append cursor */
+    int64_t found;       /* per-thread total (incl. past-cap) */
+} ps_job;
+
+static void pair_stats(const uint64_t *a, int64_t la, const uint64_t *b,
+                       int64_t lb, int size, int64_t *common_out,
+                       int64_t *total_out) {
+    int64_t i = 0, j = 0, common = 0, total = 0;
+    while (i < la && j < lb && total < size) {
+        uint64_t x = a[i], y = b[j];
+        if (x < y) {
+            i++;
+        } else if (y < x) {
+            j++;
+        } else {
+            common++;
+            i++;
+            j++;
+        }
+        total++;
+    }
+    while (i < la && total < size) {
+        i++;
+        total++;
+    }
+    while (j < lb && total < size) {
+        j++;
+        total++;
+    }
+    *common_out = common;
+    *total_out = total;
+}
+
+static void *worker(void *arg) {
+    ps_job *w = (ps_job *)arg;
+    /* interleaved rows: balances the shrinking upper triangle */
+    for (int64_t r = w->tid; r < w->n; r += w->n_threads) {
+        const uint64_t *ra = w->mat + r * w->width;
+        int64_t la = w->lens[r];
+        for (int64_t c = r + 1; c < w->n; c++) {
+            int64_t common, total;
+            pair_stats(ra, la, w->mat + c * w->width, w->lens[c],
+                       w->sketch_size, &common, &total);
+            if (total == 0 ||
+                (double)common < w->j_thr * (double)total)
+                continue;
+            double jac = (double)common / (double)total;
+            double ani =
+                common > 0
+                    ? 1.0 - (-log(2.0 * jac / (1.0 + jac)) /
+                             (double)w->kmer)
+                    : 0.0;
+            w->found++;
+            int64_t slot =
+                __sync_fetch_and_add(w->next_slot, (int64_t)1);
+            if (slot < w->cap) {
+                w->out_i[slot] = r;
+                w->out_j[slot] = c;
+                w->out_ani[slot] = ani;
+            }
+        }
+    }
+    return NULL;
+}
+
+/* Returns the TOTAL number of passing pairs (callers detect overflow by
+ * comparing against `cap`); the first min(total, cap) pairs are written
+ * to the output arrays in nondeterministic thread order. */
+int64_t galah_pair_stats_threshold(
+    const uint64_t *mat, int64_t n, int64_t width, const int64_t *lens,
+    int sketch_size, int kmer, double j_thr, int n_threads,
+    int64_t *out_i, int64_t *out_j, double *out_ani, int64_t cap) {
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > 64) n_threads = 64;
+    int64_t next_slot = 0;
+    ps_job jobs[64];
+    pthread_t tids[64];
+    for (int t = 0; t < n_threads; t++) {
+        jobs[t] = (ps_job){mat, lens, n, width, sketch_size, kmer,
+                           j_thr, t, n_threads, out_i, out_j,
+                           out_ani, cap, &next_slot, 0};
+    }
+    if (n_threads == 1) {
+        worker(&jobs[0]);
+        return jobs[0].found;
+    }
+    for (int t = 0; t < n_threads; t++)
+        pthread_create(&tids[t], NULL, worker, &jobs[t]);
+    int64_t total = 0;
+    for (int t = 0; t < n_threads; t++) {
+        pthread_join(tids[t], NULL);
+        total += jobs[t].found;
+    }
+    return total;
+}

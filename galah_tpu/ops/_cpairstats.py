@@ -1,0 +1,111 @@
+"""ctypes loader/builder for the native pair-stats kernel.
+
+Compiles csrc/pairstats.c on first import (cc + pthreads, baked-in
+toolchain) and exposes
+
+    threshold_pairs_c(mat, sketch_size, kmer, min_ani, threads)
+        -> {(i, j): ani}
+
+the compiled-C twin of ops/pairwise.threshold_pairs for host CPUs —
+bit-faithful to ops/minhash_np.mash_ani per pair (reference analog: the
+compiled pair loop of src/finch.rs:53-73). Build/load failures raise
+ImportError; set GALAH_TPU_NO_CPAIRSTATS=1 to force callers' fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import sysconfig
+
+import numpy as np
+
+from galah_tpu.ops.constants import SENTINEL
+
+if os.environ.get("GALAH_TPU_NO_CPAIRSTATS"):
+    raise ImportError("native pair stats disabled via env")
+
+_PKG_DIR = pathlib.Path(__file__).resolve().parent
+_SRC = _PKG_DIR.parent.parent / "csrc" / "pairstats.c"
+_SOSUFFIX = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+_LIB = _PKG_DIR / f"_libpairstats{_SOSUFFIX}"
+
+
+def _build() -> None:
+    if not _SRC.is_file():
+        raise ImportError(f"native pair-stats source missing: {_SRC}")
+    if _LIB.is_file() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return
+    cc = os.environ.get("CC", "cc")
+    tmp = _LIB.with_name(f"{_LIB.stem}.{os.getpid()}{_LIB.suffix}")
+    cmd = [cc, "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC),
+           "-lpthread", "-lm"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            raise ImportError(
+                f"native pair-stats build failed: "
+                f"{' '.join(cmd)}\n{proc.stderr}")
+        os.replace(tmp, _LIB)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise ImportError(f"native pair-stats build failed to run: {e}")
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+_build()
+_lib = ctypes.CDLL(str(_LIB))
+_fn = _lib.galah_pair_stats_threshold
+_fn.restype = ctypes.c_int64
+_fn.argtypes = [
+    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+    ctypes.c_double, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+]
+
+
+def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,
+                      min_ani: float, threads: int = 0,
+                      initial_cap: int = 0) -> dict:
+    """All-pairs merged-bottom-k Mash ANI at or above min_ani.
+
+    `mat` is the (N, width) uint64 SENTINEL-padded sorted sketch matrix
+    (ops/minhash.sketch_matrix layout). The keep decision is the same
+    f64 rational check as the device path (common >= j_thr * total with
+    j_thr from pairwise.ani_to_jaccard), so both backends agree on
+    borderline pairs. Retries with a grown buffer on overflow, so the
+    result is always complete (`initial_cap` exists for tests).
+    """
+    from galah_tpu.ops.pairwise import ani_to_jaccard
+
+    mat = np.ascontiguousarray(mat, dtype=np.uint64)
+    n, width = mat.shape
+    lens = (mat != np.uint64(SENTINEL)).sum(axis=1).astype(np.int64)
+    if threads <= 0:
+        threads = os.cpu_count() or 1
+    j_thr = ani_to_jaccard(min_ani, kmer)
+    cap = initial_cap if initial_cap > 0 else max(4 * n + 1024, 1 << 16)
+    while True:
+        out_i = np.empty(cap, dtype=np.int64)
+        out_j = np.empty(cap, dtype=np.int64)
+        out_ani = np.empty(cap, dtype=np.float64)
+        total = _fn(
+            mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            n, width,
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            sketch_size, kmer, float(j_thr), int(threads),
+            out_i.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            out_j.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            out_ani.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            cap)
+        if total <= cap:
+            break
+        cap = int(total) + 1024
+    m = int(min(total, cap))
+    return {(int(out_i[x]), int(out_j[x])): float(out_ani[x])
+            for x in range(m)}
