@@ -1,0 +1,276 @@
+/* Native MinHash sketcher: codes -> bottom-k distinct canonical k-mer
+ * hashes, single pass.
+ *
+ * Compiled-C twin of the JAX sketch pipeline (galah_tpu/ops/hashing.py
+ * + ops/minhash.py) for CPU backends — the reference's finch sketching
+ * is compiled Rust doing this exact job (reference: src/finch.rs:33-47,
+ * sketch_files). Bit-identical contract:
+ *   - canonical k-mer = lexicographic min of the forward ASCII k-mer
+ *     and its reverse complement (A<C<G<T matches ASCII order, so the
+ *     2-bit MSB-first packed integers compare identically);
+ *   - "murmur3": MurmurHash3 x64_128 h1 (h1+h2 finalization) over the
+ *     canonical ASCII bytes, seed as given;
+ *   - "tpufast": the multiply-free shift-add mixer over the canonical
+ *     2-bit packed key (mirrors hashing._tpufast_mix);
+ *   - windows containing an ambiguous base (code 255) or crossing a
+ *     contig boundary produce no hash;
+ *   - result = the sketch_size smallest DISTINCT hash values, sorted.
+ *
+ * The rolling 2-bit packs make the per-position cost O(1); bottom-k is
+ * a threshold + candidate buffer with periodic sort/dedup/merge.
+ */
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* ---------------- murmur3 x64_128 (h1 + h2, return h1) ------------- */
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+    return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t fmix64(uint64_t x) {
+    x ^= x >> 33;
+    x *= 0xFF51AFD7ED558CCDull;
+    x ^= x >> 33;
+    x *= 0xC4CEB9FE1A85EC53ull;
+    x ^= x >> 33;
+    return x;
+}
+
+static uint64_t murmur3_x64_128_h1(const uint8_t *key, int len,
+                                   uint64_t seed) {
+    const uint64_t c1 = 0x87C37B91114253D5ull;
+    const uint64_t c2 = 0x4CF5AD432745937Full;
+    uint64_t h1 = seed, h2 = seed;
+    int nblocks = len / 16;
+    for (int b = 0; b < nblocks; b++) {
+        uint64_t k1, k2;
+        memcpy(&k1, key + b * 16, 8);      /* little-endian hosts */
+        memcpy(&k2, key + b * 16 + 8, 8);
+        k1 *= c1;
+        k1 = rotl64(k1, 31);
+        k1 *= c2;
+        h1 ^= k1;
+        h1 = rotl64(h1, 27);
+        h1 += h2;
+        h1 = h1 * 5 + 0x52DCE729ull;
+        k2 *= c2;
+        k2 = rotl64(k2, 33);
+        k2 *= c1;
+        h2 ^= k2;
+        h2 = rotl64(h2, 31);
+        h2 += h1;
+        h2 = h2 * 5 + 0x38495AB5ull;
+    }
+    const uint8_t *tail = key + nblocks * 16;
+    int rem = len & 15;
+    uint64_t k1 = 0, k2 = 0;
+    for (int b = rem - 1; b >= 8; b--) k2 = (k2 << 8) | tail[b];
+    if (rem > 8) {
+        k2 *= c2;
+        k2 = rotl64(k2, 33);
+        k2 *= c1;
+        h2 ^= k2;
+    }
+    int top = rem < 8 ? rem : 8;
+    for (int b = top - 1; b >= 0; b--) k1 = (k1 << 8) | tail[b];
+    if (rem > 0) {
+        k1 *= c1;
+        k1 = rotl64(k1, 31);
+        k1 *= c2;
+        h1 ^= k1;
+    }
+    h1 ^= (uint64_t)len;
+    h2 ^= (uint64_t)len;
+    h1 += h2;
+    h2 += h1;
+    h1 = fmix64(h1);
+    h2 = fmix64(h2);
+    h1 += h2;
+    return h1;
+}
+
+/* ---------------- tpufast mixer (mirrors hashing._tpufast_mix) ----- */
+
+static uint64_t tpufast_mix(uint64_t x, uint64_t seed) {
+    x ^= seed * 0x9E3779B97F4A7C15ull + 0x1B873593ull;
+    static const int rounds[3][3] = {
+        {21, 37, 29}, {13, 47, 31}, {17, 41, 33}};
+    for (int r = 0; r < 3; r++) {
+        x = x + (x << rounds[r][0]) + (x << rounds[r][1]);
+        x = x ^ (x >> rounds[r][2]);
+    }
+    x = x + (x << 26);
+    x = x ^ (x >> 32);
+    return x;
+}
+
+/* ---------------- bottom-k distinct accumulator -------------------- */
+
+typedef struct {
+    uint64_t *sketch;   /* sorted distinct, <= size entries */
+    int n_sketch;
+    int size;
+    uint64_t thr;       /* current admission threshold */
+    uint64_t *cand;
+    int n_cand, cap;
+} bk_acc;
+
+static int cmp_u64(const void *a, const void *b) {
+    uint64_t x = *(const uint64_t *)a, y = *(const uint64_t *)b;
+    return x < y ? -1 : (x > y ? 1 : 0);
+}
+
+static void bk_compact(bk_acc *acc) {
+    /* merge sketch + candidates, dedup, keep the smallest `size` */
+    int m = acc->n_sketch + acc->n_cand;
+    uint64_t *buf = acc->cand; /* reuse: copy sketch in, sort whole */
+    /* cand buffer has cap >= size + slack; ensure room */
+    memcpy(buf + acc->n_cand, acc->sketch,
+           (size_t)acc->n_sketch * sizeof(uint64_t));
+    qsort(buf, (size_t)m, sizeof(uint64_t), cmp_u64);
+    int out = 0;
+    for (int i = 0; i < m && out < acc->size; i++) {
+        if (i > 0 && buf[i] == buf[i - 1]) continue;
+        acc->sketch[out++] = buf[i];
+    }
+    acc->n_sketch = out;
+    acc->n_cand = 0;
+    if (out == acc->size) acc->thr = acc->sketch[out - 1];
+}
+
+static inline void bk_add(bk_acc *acc, uint64_t h) {
+    if (h >= acc->thr) return;
+    acc->cand[acc->n_cand++] = h;
+    if (acc->n_cand >= acc->cap - acc->size) bk_compact(acc);
+}
+
+/* ---------------- positional hashes -------------------------------- */
+
+/* Every window's canonical hash in genome order; invalid windows
+ * (ambiguous base / contig crossing) get the 0xFFFF..FF sentinel.
+ * out: uint64[n - k + 1]. Twin of ops/fragment_ani.positional_hashes.
+ * Returns n - k + 1, or 0 when n < k. */
+int64_t galah_positional_hashes(const uint8_t *codes, int64_t n,
+                                const int64_t *offsets,
+                                int64_t n_offsets, int k, uint64_t seed,
+                                int algo, uint64_t *out) {
+    if (n < k || k < 1 || k > 32) return 0;
+    const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
+    const uint64_t mask = k < 32 ? (1ull << (2 * k)) - 1 : ~0ull;
+    const int shift_hi = 2 * (k - 1);
+    static const char ASCII[4] = {'A', 'C', 'G', 'T'};
+    const int64_t *interior = offsets + 1;
+    int64_t n_int = n_offsets >= 2 ? n_offsets - 2 : 0;
+    int64_t bptr = 0;
+    uint64_t fwd = 0, rev = 0;
+    int valid_run = 0;
+    uint8_t keybuf[32];
+    int64_t n_win = n - k + 1;
+
+    for (int64_t i = 0; i < n; i++) {
+        uint8_t c = codes[i];
+        int64_t p = i - k + 1;
+        if (c > 3) {
+            valid_run = 0;
+        } else {
+            valid_run++;
+            fwd = ((fwd << 2) | c) & mask;
+            rev = (rev >> 2) | ((uint64_t)(3 - c) << shift_hi);
+        }
+        if (p < 0) continue;
+        if (valid_run < k) {
+            out[p] = SENT;
+            continue;
+        }
+        while (bptr < n_int && interior[bptr] <= p) bptr++;
+        if (bptr < n_int && interior[bptr] < p + k) {
+            out[p] = SENT;
+            continue;
+        }
+        uint64_t canon = fwd <= rev ? fwd : rev;
+        if (algo == 1) {
+            out[p] = tpufast_mix(canon, seed);
+        } else {
+            for (int b = 0; b < k; b++)
+                keybuf[b] =
+                    (uint8_t)ASCII[(canon >> (2 * (k - 1 - b))) & 3];
+            out[p] = murmur3_x64_128_h1(keybuf, k, seed);
+        }
+    }
+    return n_win;
+}
+
+/* ---------------- main entry --------------------------------------- */
+
+/* codes: uint8[n], values 0-3 or 255 (ambiguous).
+ * offsets: int64[n_offsets] full contig offset array [0, ..., n].
+ * algo: 0 = murmur3, 1 = tpufast.
+ * out: uint64[sketch_size]; returns number of hashes written. */
+int64_t galah_sketch_bottomk(const uint8_t *codes, int64_t n,
+                             const int64_t *offsets, int64_t n_offsets,
+                             int k, int sketch_size, uint64_t seed,
+                             int algo, uint64_t *out) {
+    if (n < k || k < 1 || k > 32 || sketch_size < 1) return 0;
+
+    bk_acc acc;
+    acc.size = sketch_size;
+    acc.sketch = (uint64_t *)malloc((size_t)sketch_size * 8);
+    acc.n_sketch = 0;
+    acc.thr = 0xFFFFFFFFFFFFFFFFull;
+    acc.cap = sketch_size + 4096 + sketch_size;
+    acc.cand = (uint64_t *)malloc((size_t)acc.cap * 8);
+    acc.n_cand = 0;
+    if (!acc.sketch || !acc.cand) {
+        free(acc.sketch);
+        free(acc.cand);
+        return -1;
+    }
+
+    const uint64_t mask = k < 32 ? (1ull << (2 * k)) - 1 : ~0ull;
+    const int shift_hi = 2 * (k - 1);
+    static const char ASCII[4] = {'A', 'C', 'G', 'T'};
+
+    /* interior contig boundaries (exclude 0 and n) */
+    const int64_t *interior = offsets + 1;
+    int64_t n_int = n_offsets >= 2 ? n_offsets - 2 : 0;
+    int64_t bptr = 0;
+
+    uint64_t fwd = 0, rev = 0;
+    int valid_run = 0; /* consecutive non-ambiguous codes ending here */
+    uint8_t keybuf[32];
+
+    for (int64_t i = 0; i < n; i++) {
+        uint8_t c = codes[i];
+        if (c > 3) {
+            valid_run = 0;
+            continue;
+        }
+        valid_run++;
+        fwd = ((fwd << 2) | c) & mask;
+        rev = (rev >> 2) | ((uint64_t)(3 - c) << shift_hi);
+        if (valid_run < k) continue;
+        int64_t p = i - k + 1; /* window start */
+        while (bptr < n_int && interior[bptr] <= p) bptr++;
+        if (bptr < n_int && interior[bptr] < p + k) continue;
+        uint64_t canon = fwd <= rev ? fwd : rev;
+        uint64_t h;
+        if (algo == 1) {
+            h = tpufast_mix(canon, seed);
+        } else {
+            for (int b = 0; b < k; b++)
+                keybuf[b] =
+                    (uint8_t)ASCII[(canon >> (2 * (k - 1 - b))) & 3];
+            h = murmur3_x64_128_h1(keybuf, k, seed);
+        }
+        bk_add(&acc, h);
+    }
+    bk_compact(&acc);
+    int64_t out_n = acc.n_sketch;
+    memcpy(out, acc.sketch, (size_t)out_n * 8);
+    free(acc.sketch);
+    free(acc.cand);
+    return out_n;
+}

@@ -1,0 +1,64 @@
+"""Shared ctypes builder/loader for the native C kernels.
+
+One implementation of the compile-on-first-import idiom used by
+io/_cingest.py, ops/_cpairstats.py, and ops/_csketch.py: mtime-checked
+rebuild, pid-suffixed temp + atomic os.replace (concurrent importers
+never dlopen a half-written library), and a process-wide failure cache
+so a broken toolchain or read-only package dir raises ImportError
+instantly on every retry instead of re-spawning the compiler per call
+(the caller modules are evicted from sys.modules when their import
+fails, so without this cache each fallback call would re-run cc).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import sysconfig
+
+_PKG_DIR = pathlib.Path(__file__).resolve().parent
+_CSRC = _PKG_DIR.parent.parent / "csrc"
+_SOSUFFIX = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+
+_FAILED: dict[str, str] = {}
+
+
+def build_and_load(src_name: str, lib_stem: str,
+                   extra_flags: tuple = (),
+                   disable_env: str | None = None) -> ctypes.CDLL:
+    """Compile csrc/<src_name> into ops/<lib_stem><EXT_SUFFIX> (when
+    stale) and dlopen it. Raises ImportError on any failure — cached, so
+    repeated attempts are cheap."""
+    if disable_env and os.environ.get(disable_env):
+        raise ImportError(f"native kernel disabled via {disable_env}")
+    if src_name in _FAILED:
+        raise ImportError(_FAILED[src_name])
+    try:
+        src = _CSRC / src_name
+        if not src.is_file():
+            raise ImportError(f"native source missing: {src}")
+        lib = _PKG_DIR / f"{lib_stem}{_SOSUFFIX}"
+        if not (lib.is_file()
+                and lib.stat().st_mtime >= src.stat().st_mtime):
+            cc = os.environ.get("CC", "cc")
+            tmp = lib.with_name(f"{lib.stem}.{os.getpid()}{lib.suffix}")
+            cmd = [cc, "-O3", "-shared", "-fPIC", "-o", str(tmp),
+                   str(src), *extra_flags]
+            try:
+                proc = subprocess.run(cmd, capture_output=True,
+                                      text=True, timeout=120)
+                if proc.returncode != 0:
+                    raise ImportError(
+                        f"native build failed: {' '.join(cmd)}\n"
+                        f"{proc.stderr}")
+                os.replace(tmp, lib)
+            except (OSError, subprocess.TimeoutExpired) as e:
+                raise ImportError(f"native build failed to run: {e}")
+            finally:
+                tmp.unlink(missing_ok=True)
+        return ctypes.CDLL(str(lib))
+    except ImportError as e:
+        _FAILED[src_name] = str(e)
+        raise

@@ -1,63 +1,30 @@
-"""ctypes loader/builder for the native pair-stats kernel.
+"""ctypes binding for the native pair-stats kernel (csrc/pairstats.c).
 
-Compiles csrc/pairstats.c on first import (cc + pthreads, baked-in
-toolchain) and exposes
+Exposes
 
     threshold_pairs_c(mat, sketch_size, kmer, min_ani, threads)
         -> {(i, j): ani}
 
 the compiled-C twin of ops/pairwise.threshold_pairs for host CPUs —
-bit-faithful to ops/minhash_np.mash_ani per pair (reference analog: the
-compiled pair loop of src/finch.rs:53-73). Build/load failures raise
-ImportError; set GALAH_TPU_NO_CPAIRSTATS=1 to force callers' fallbacks.
+same f64 rational keep-check, same Mash ANI values (reference analog:
+the compiled pair loop of src/finch.rs:53-73). Build/load failures
+raise ImportError (cached by ops/_cbuild); set
+GALAH_TPU_NO_CPAIRSTATS=1 to force callers' fallbacks.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-import pathlib
-import subprocess
-import sysconfig
 
 import numpy as np
 
+from galah_tpu.ops import _cbuild
 from galah_tpu.ops.constants import SENTINEL
 
-if os.environ.get("GALAH_TPU_NO_CPAIRSTATS"):
-    raise ImportError("native pair stats disabled via env")
-
-_PKG_DIR = pathlib.Path(__file__).resolve().parent
-_SRC = _PKG_DIR.parent.parent / "csrc" / "pairstats.c"
-_SOSUFFIX = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-_LIB = _PKG_DIR / f"_libpairstats{_SOSUFFIX}"
-
-
-def _build() -> None:
-    if not _SRC.is_file():
-        raise ImportError(f"native pair-stats source missing: {_SRC}")
-    if _LIB.is_file() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
-        return
-    cc = os.environ.get("CC", "cc")
-    tmp = _LIB.with_name(f"{_LIB.stem}.{os.getpid()}{_LIB.suffix}")
-    cmd = [cc, "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC),
-           "-lpthread", "-lm"]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
-        if proc.returncode != 0:
-            raise ImportError(
-                f"native pair-stats build failed: "
-                f"{' '.join(cmd)}\n{proc.stderr}")
-        os.replace(tmp, _LIB)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        raise ImportError(f"native pair-stats build failed to run: {e}")
-    finally:
-        tmp.unlink(missing_ok=True)
-
-
-_build()
-_lib = ctypes.CDLL(str(_LIB))
+_lib = _cbuild.build_and_load(
+    "pairstats.c", "_libpairstats", extra_flags=("-lpthread", "-lm"),
+    disable_env="GALAH_TPU_NO_CPAIRSTATS")
 _fn = _lib.galah_pair_stats_threshold
 _fn.restype = ctypes.c_int64
 _fn.argtypes = [

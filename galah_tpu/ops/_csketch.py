@@ -1,0 +1,92 @@
+"""ctypes binding for the native MinHash sketcher (csrc/sketch.c).
+
+Exposes
+
+    sketch_bottomk(codes, contig_offsets, k, sketch_size, seed, algo)
+        -> uint64[<=sketch_size] sorted distinct bottom-k hashes
+    positional_hashes(codes, contig_offsets, k, seed, algo)
+        -> uint64[n-k+1] genome-order hashes, SENTINEL where invalid
+
+bit-identical to the JAX pipelines (ops/minhash.py,
+ops/fragment_ani.py) for both hash algorithms and full 64-bit seeds —
+the CPU-backend fast path for sketching (reference analog: finch's
+compiled sketching, src/finch.rs:33-47). Build/load failures raise
+ImportError (cached by ops/_cbuild); set GALAH_TPU_NO_CSKETCH=1 to
+force the JAX path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from galah_tpu.ops import _cbuild
+
+_lib = _cbuild.build_and_load(
+    "sketch.c", "_libsketch", disable_env="GALAH_TPU_NO_CSKETCH")
+
+_ALGOS = {"murmur3": 0, "tpufast": 1}
+
+_fn = _lib.galah_sketch_bottomk
+_fn.restype = ctypes.c_int64
+_fn.argtypes = [
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_uint64),
+]
+_fn_pos = _lib.galah_positional_hashes
+_fn_pos.restype = ctypes.c_int64
+_fn_pos.argtypes = [
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_uint64),
+]
+
+
+def _check(algo: str, k: int) -> None:
+    if algo not in _ALGOS:
+        raise ValueError(f"unknown hash algorithm {algo!r}")
+    if not 1 <= k <= 32:
+        raise ValueError(f"k must be in [1, 32], got {k}")
+
+
+def sketch_bottomk(codes: np.ndarray, contig_offsets, k: int,
+                   sketch_size: int, seed: int, algo: str) -> np.ndarray:
+    """Sorted distinct bottom-k canonical k-mer hashes of a genome."""
+    _check(algo, k)
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    offs = np.ascontiguousarray(contig_offsets, dtype=np.int64)
+    out = np.empty(sketch_size, dtype=np.uint64)
+    n = _fn(codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            codes.shape[0],
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            offs.shape[0], int(k), int(sketch_size),
+            int(seed) & 0xFFFFFFFFFFFFFFFF, _ALGOS[algo],
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    if n < 0:
+        raise MemoryError("native sketcher allocation failed")
+    return out[:n].copy()
+
+
+def positional_hashes(codes: np.ndarray, contig_offsets, k: int,
+                      seed: int = 0,
+                      algo: str = "murmur3") -> np.ndarray:
+    """Every window's canonical hash in genome order (SENTINEL where
+    invalid) — C twin of ops/fragment_ani.positional_hashes."""
+    _check(algo, k)
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    offs = np.ascontiguousarray(contig_offsets, dtype=np.int64)
+    n = codes.shape[0]
+    if n < k:
+        return np.zeros(0, dtype=np.uint64)
+    out = np.empty(n - k + 1, dtype=np.uint64)
+    got = _fn_pos(
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offs.shape[0], int(k), int(seed) & 0xFFFFFFFFFFFFFFFF,
+        _ALGOS[algo],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    return out[:max(got, 0)]

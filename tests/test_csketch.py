@@ -1,0 +1,109 @@
+"""Native C MinHash sketcher: bit-parity with the numpy/JAX pipelines
+(reference analog: finch's compiled sketching, src/finch.rs:33-47)."""
+
+import numpy as np
+import pytest
+
+from galah_tpu.io import read_genome
+from galah_tpu.ops import minhash_np
+
+csk = pytest.importorskip("galah_tpu.ops._csketch")
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return read_genome(str(p))
+
+
+@pytest.mark.parametrize("seq_len", [25, 3000, 70_000])
+def test_c_matches_numpy(tmp_path, seq_len):
+    rng = np.random.default_rng(7)
+    seq = "".join(rng.choice(list("ACGT"), size=seq_len))
+    g = _write(tmp_path, "g.fna",
+               f">a\n{seq[: seq_len // 2]}N{seq[seq_len // 2:]}\n"
+               f">b\n{seq[:40]}\n")
+    want = minhash_np.sketch_genome(g, sketch_size=64)
+    got = csk.sketch_bottomk(g.codes, g.contig_offsets, k=21,
+                             sketch_size=64, seed=0, algo="murmur3")
+    np.testing.assert_array_equal(want.hashes, got)
+
+
+def test_c_golden_finch_ani(ref_data):
+    g1 = read_genome(str(ref_data / "set1" / "1mbp.fna"))
+    g2 = read_genome(str(ref_data / "set1" / "500kb.fna"))
+    h1 = csk.sketch_bottomk(g1.codes, g1.contig_offsets, 21, 1000, 0,
+                            "murmur3")
+    h2 = csk.sketch_bottomk(g2.codes, g2.contig_offsets, 21, 1000, 0,
+                            "murmur3")
+    a = minhash_np.MinHashSketch(h1, 1000, 21)
+    b = minhash_np.MinHashSketch(h2, 1000, 21)
+    assert np.float32(minhash_np.mash_ani(a, b)) == np.float32(0.9808188)
+
+
+def test_c_tpufast_matches_jax(tmp_path):
+    from galah_tpu.ops.minhash import sketch_genome_device
+
+    rng = np.random.default_rng(9)
+    seq = "".join(rng.choice(list("ACGT"), size=20_000))
+    g = _write(tmp_path, "t.fna", f">a\n{seq}\nN\n>b\n{seq[:90]}\n")
+    # chunk=2048 pins the JAX pipeline (non-default chunk)
+    want = sketch_genome_device(g, sketch_size=128, algo="tpufast",
+                                chunk=2048)
+    got = csk.sketch_bottomk(g.codes, g.contig_offsets, k=21,
+                             sketch_size=128, seed=0, algo="tpufast")
+    np.testing.assert_array_equal(want.hashes, got)
+
+
+def test_sketch_genome_device_uses_c_on_cpu(tmp_path):
+    """Default-path sketch_genome_device output equals the pinned JAX
+    chunk pipeline (exercises the C fast-path switch)."""
+    from galah_tpu.ops.minhash import sketch_genome_device
+
+    rng = np.random.default_rng(10)
+    seq = "".join(rng.choice(list("ACGT"), size=30_000))
+    g = _write(tmp_path, "c.fna", f">a\n{seq}\n")
+    default = sketch_genome_device(g, sketch_size=100)
+    pinned_jax = sketch_genome_device(g, sketch_size=100, chunk=4096)
+    np.testing.assert_array_equal(default.hashes, pinned_jax.hashes)
+
+
+def test_c_short_and_empty(tmp_path):
+    g = _write(tmp_path, "s.fna", ">a\nACGTACGT\n")
+    out = csk.sketch_bottomk(g.codes, g.contig_offsets, 21, 64, 0,
+                             "murmur3")
+    assert out.shape == (0,)
+
+
+def test_c_positional_hashes_matches_jax(tmp_path):
+    """C positional hashes equal the JAX chunk pipeline entry-for-entry
+    (SENTINEL masking at N bases and contig boundaries included)."""
+    from galah_tpu.ops import fragment_ani
+
+    rng = np.random.default_rng(12)
+    seq = "".join(rng.choice(list("ACGT"), size=12_000))
+    g = _write(tmp_path, "p.fna",
+               f">a\n{seq[:5000]}N{seq[5000:8000]}\n>b\n{seq[8000:]}\n")
+    want = fragment_ani.positional_hashes(g, k=15, chunk=2048)  # JAX
+    got = csk.positional_hashes(g.codes, g.contig_offsets, k=15)
+    np.testing.assert_array_equal(want, got)
+    # and the default path (C on CPU) agrees too
+    np.testing.assert_array_equal(
+        fragment_ani.positional_hashes(g, k=15), got)
+
+
+def test_c_64bit_seed_parity(tmp_path):
+    """Seeds above 2^32 hash identically to the JAX pipeline (the C ABI
+    carries the full 64-bit seed)."""
+    from galah_tpu.ops.minhash import sketch_genome_device
+
+    rng = np.random.default_rng(13)
+    seq = "".join(rng.choice(list("ACGT"), size=9000))
+    g = _write(tmp_path, "z.fna", f">a\n{seq}\n")
+    big = (1 << 40) + 12345
+    for algo in ("murmur3", "tpufast"):
+        want = sketch_genome_device(g, sketch_size=64, seed=big,
+                                    algo=algo, chunk=2048)  # JAX path
+        got = csk.sketch_bottomk(g.codes, g.contig_offsets, k=21,
+                                 sketch_size=64, seed=big, algo=algo)
+        np.testing.assert_array_equal(want.hashes, got)
