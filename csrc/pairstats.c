@@ -99,6 +99,38 @@ static void *worker(void *arg) {
     return NULL;
 }
 
+/* Per-window fragment membership counts: for each row of `wins`
+ * (SENTINEL-masked positional hash windows, ops/fragment_ani
+ * GenomeProfile.windows layout), count valid hashes and how many are
+ * present in the sorted distinct `ref` set (binary search) — the C twin
+ * of ops/fragment_ani._window_match_counts_impl for CPU backends. */
+void galah_window_match_counts(const uint64_t *wins, int64_t W,
+                               int64_t L, const uint64_t *ref,
+                               int64_t H, int32_t *matched,
+                               int32_t *total) {
+    const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
+    for (int64_t w = 0; w < W; w++) {
+        const uint64_t *row = wins + w * L;
+        int32_t m = 0, t = 0;
+        for (int64_t i = 0; i < L; i++) {
+            uint64_t h = row[i];
+            if (h == SENT) continue;
+            t++;
+            int64_t lo = 0, hi = H;
+            while (lo < hi) {
+                int64_t mid = (lo + hi) >> 1;
+                if (ref[mid] < h)
+                    lo = mid + 1;
+                else
+                    hi = mid;
+            }
+            if (lo < H && ref[lo] == h) m++;
+        }
+        matched[w] = m;
+        total[w] = t;
+    }
+}
+
 /* Returns the TOTAL number of passing pairs (callers detect overflow by
  * comparing against `cap`); the first min(total, cap) pairs are written
  * to the output arrays in nondeterministic thread order. */

@@ -16,42 +16,12 @@ from __future__ import annotations
 import ctypes
 import os
 import pathlib
-import subprocess
-import sysconfig
 
 import numpy as np
 
-if os.environ.get("GALAH_TPU_NO_CINGEST"):
-    raise ImportError("native ingestion disabled via GALAH_TPU_NO_CINGEST")
+from galah_tpu.utils import cbuild
 
 _PKG_DIR = pathlib.Path(__file__).resolve().parent
-_SRC = _PKG_DIR.parent.parent / "csrc" / "ingest.c"
-_SOSUFFIX = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-_LIB = _PKG_DIR / f"_libingest{_SOSUFFIX}"
-
-
-def _build() -> None:
-    if not _SRC.is_file():
-        raise ImportError(f"native ingestion source missing: {_SRC}")
-    if _LIB.is_file() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
-        return
-    cc = os.environ.get("CC", "cc")
-    # Compile to a temp path and os.replace for an atomic publish, so
-    # concurrent importers never dlopen a half-written library.
-    tmp = _LIB.with_name(f"{_LIB.stem}.{os.getpid()}{_LIB.suffix}")
-    cmd = [cc, "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC), "-lz"]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
-        if proc.returncode != 0:
-            raise ImportError(
-                f"native ingestion build failed: "
-                f"{' '.join(cmd)}\n{proc.stderr}")
-        os.replace(tmp, _LIB)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        raise ImportError(f"native ingestion build failed to run: {e}")
-    finally:
-        tmp.unlink(missing_ok=True)
 
 
 class _GalahGenome(ctypes.Structure):
@@ -65,11 +35,9 @@ class _GalahGenome(ctypes.Structure):
     ]
 
 
-_build()
-try:
-    _dll = ctypes.CDLL(str(_LIB))
-except OSError as e:
-    raise ImportError(f"native ingestion library failed to load: {e}")
+_dll = cbuild.build_and_load(
+    "ingest.c", "_libingest", out_dir=_PKG_DIR,
+    extra_flags=("-lz",), disable_env="GALAH_TPU_NO_CINGEST")
 
 _dll.galah_read_fasta.argtypes = [ctypes.c_char_p,
                                   ctypes.POINTER(_GalahGenome)]

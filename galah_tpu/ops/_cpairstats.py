@@ -19,11 +19,13 @@ import os
 
 import numpy as np
 
-from galah_tpu.ops import _cbuild
 from galah_tpu.ops.constants import SENTINEL
+from galah_tpu.utils import cbuild
 
-_lib = _cbuild.build_and_load(
-    "pairstats.c", "_libpairstats", extra_flags=("-lpthread", "-lm"),
+_lib = cbuild.build_and_load(
+    "pairstats.c", "_libpairstats",
+    out_dir=os.path.dirname(os.path.abspath(__file__)),
+    extra_flags=("-lpthread", "-lm"),
     disable_env="GALAH_TPU_NO_CPAIRSTATS")
 _fn = _lib.galah_pair_stats_threshold
 _fn.restype = ctypes.c_int64
@@ -34,6 +36,38 @@ _fn.argtypes = [
     ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
     ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
 ]
+
+
+_fn_wm = _lib.galah_window_match_counts
+_fn_wm.restype = None
+_fn_wm.argtypes = [
+    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+]
+
+
+def window_match_counts(wins: np.ndarray,
+                        ref_set: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+    """Per-window (matched, valid) counts of SENTINEL-masked hash
+    windows against a sorted distinct reference set — C twin of
+    ops/fragment_ani._window_match_counts_impl."""
+    wins = np.ascontiguousarray(wins, dtype=np.uint64)
+    ref_set = np.ascontiguousarray(ref_set, dtype=np.uint64)
+    if wins.ndim != 2:
+        raise ValueError(
+            f"wins must be a (W, L) window matrix, got shape "
+            f"{wins.shape}")
+    w = wins.shape[0]
+    matched = np.empty(w, dtype=np.int32)
+    total = np.empty(w, dtype=np.int32)
+    _fn_wm(wins.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+           w, wins.shape[1],
+           ref_set.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+           ref_set.shape[0],
+           matched.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+           total.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return matched, total
 
 
 def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,

@@ -18,13 +18,16 @@ force the JAX path.
 from __future__ import annotations
 
 import ctypes
+import os
 
 import numpy as np
 
-from galah_tpu.ops import _cbuild
+from galah_tpu.utils import cbuild
 
-_lib = _cbuild.build_and_load(
-    "sketch.c", "_libsketch", disable_env="GALAH_TPU_NO_CSKETCH")
+_lib = cbuild.build_and_load(
+    "sketch.c", "_libsketch",
+    out_dir=os.path.dirname(os.path.abspath(__file__)),
+    disable_env="GALAH_TPU_NO_CSKETCH")
 
 _ALGOS = {"murmur3": 0, "tpufast": 1}
 

@@ -72,6 +72,8 @@ class GenomeProfile:
     # single-device upload and the batch-sharding assembly path)
     _np_windows_padded: Optional[np.ndarray] = None
     _np_ref_padded: Optional[np.ndarray] = None
+    # unpadded windows, cached for the C membership fast path
+    _np_windows: Optional[np.ndarray] = None
 
     @property
     def n_windows(self) -> int:
@@ -108,7 +110,12 @@ class GenomeProfile:
         per-window (matched, total) integers are unchanged (counting is
         SENTINEL-aware and order-independent), but the membership-test
         work really does drop ~c-fold.
+
+        Cached after the first call (the greedy loop re-queries the
+        same profile across many batches).
         """
+        if self._np_windows is not None:
+            return self._np_windows
         L = self.fraglen
         flat = self.flat_hashes
         w = self.n_windows
@@ -126,6 +133,7 @@ class GenomeProfile:
             slots = max(int(counts.max()) if counts.size else 1, 1)
             slots = -(-slots // 64) * 64
             wins = wins[:, :slots].copy()
+        self._np_windows = wins
         return wins
 
 
@@ -377,6 +385,23 @@ def directed_ani_batch(
     src/fastani.rs:88-105) — and the reason the engine's backend
     interface is batched (see backends/base.py).
     """
+    # Single-device CPU backend: the compiled-C membership counter
+    # (csrc/pairstats.c::galah_window_match_counts) beats the XLA-CPU
+    # searchsorted dispatch per pair and needs no padding. Multi-device
+    # runtimes keep the sharded vmapped path.
+    if jax.default_backend() == "cpu" and jax.device_count() == 1:
+        try:
+            from galah_tpu.ops._cpairstats import window_match_counts
+        except ImportError:
+            window_match_counts = None  # no C toolchain: JAX path
+        if window_match_counts is not None:
+            return [
+                _directed_from_counts(
+                    *window_match_counts(q.windows(), r.ref_set),
+                    q, identity_floor, min_window_valid_frac)
+                for q, r in queries
+            ]
+
     out: "list[Optional[DirectedANI]]" = [None] * len(queries)
     groups: "dict[tuple, list[int]]" = {}
     for n, (q, r) in enumerate(queries):

@@ -8,6 +8,9 @@ so a broken toolchain or read-only package dir raises ImportError
 instantly on every retry instead of re-spawning the compiler per call
 (the caller modules are evicted from sys.modules when their import
 fails, so without this cache each fallback call would re-run cc).
+Every failure mode — including a corrupt/incompatible existing library
+(dlopen OSError) — surfaces as ImportError, the contract the callers'
+JAX/numpy fallbacks catch.
 """
 
 from __future__ import annotations
@@ -18,19 +21,18 @@ import pathlib
 import subprocess
 import sysconfig
 
-_PKG_DIR = pathlib.Path(__file__).resolve().parent
-_CSRC = _PKG_DIR.parent.parent / "csrc"
+_CSRC = pathlib.Path(__file__).resolve().parent.parent.parent / "csrc"
 _SOSUFFIX = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
 
 _FAILED: dict[str, str] = {}
 
 
-def build_and_load(src_name: str, lib_stem: str,
+def build_and_load(src_name: str, lib_stem: str, out_dir,
                    extra_flags: tuple = (),
                    disable_env: str | None = None) -> ctypes.CDLL:
-    """Compile csrc/<src_name> into ops/<lib_stem><EXT_SUFFIX> (when
-    stale) and dlopen it. Raises ImportError on any failure — cached, so
-    repeated attempts are cheap."""
+    """Compile csrc/<src_name> into <out_dir>/<lib_stem><EXT_SUFFIX>
+    (when stale) and dlopen it. Raises ImportError on any failure —
+    cached, so repeated attempts are cheap."""
     if disable_env and os.environ.get(disable_env):
         raise ImportError(f"native kernel disabled via {disable_env}")
     if src_name in _FAILED:
@@ -39,7 +41,7 @@ def build_and_load(src_name: str, lib_stem: str,
         src = _CSRC / src_name
         if not src.is_file():
             raise ImportError(f"native source missing: {src}")
-        lib = _PKG_DIR / f"{lib_stem}{_SOSUFFIX}"
+        lib = pathlib.Path(out_dir) / f"{lib_stem}{_SOSUFFIX}"
         if not (lib.is_file()
                 and lib.stat().st_mtime >= src.stat().st_mtime):
             cc = os.environ.get("CC", "cc")
@@ -58,7 +60,10 @@ def build_and_load(src_name: str, lib_stem: str,
                 raise ImportError(f"native build failed to run: {e}")
             finally:
                 tmp.unlink(missing_ok=True)
-        return ctypes.CDLL(str(lib))
+        try:
+            return ctypes.CDLL(str(lib))
+        except OSError as e:
+            raise ImportError(f"native library load failed ({lib}): {e}")
     except ImportError as e:
         _FAILED[src_name] = str(e)
         raise
