@@ -103,32 +103,62 @@ static void *worker(void *arg) {
  * (SENTINEL-masked positional hash windows, ops/fragment_ani
  * GenomeProfile.windows layout), count valid hashes and how many are
  * present in the sorted distinct `ref` set (binary search) — the C twin
- * of ops/fragment_ani._window_match_counts_impl for CPU backends. */
-void galah_window_match_counts(const uint64_t *wins, int64_t W,
-                               int64_t L, const uint64_t *ref,
-                               int64_t H, int32_t *matched,
-                               int32_t *total) {
+ * of ops/fragment_ani._window_match_counts_impl for CPU backends.
+ * Rows are split across n_threads (each row is independent). */
+
+typedef struct {
+    const uint64_t *wins, *ref;
+    int64_t W, L, H;
+    int32_t *matched, *total;
+    int tid, n_threads;
+} wm_job;
+
+static void *wm_worker(void *arg) {
+    wm_job *w = (wm_job *)arg;
     const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
-    for (int64_t w = 0; w < W; w++) {
-        const uint64_t *row = wins + w * L;
+    for (int64_t r = w->tid; r < w->W; r += w->n_threads) {
+        const uint64_t *row = w->wins + r * w->L;
         int32_t m = 0, t = 0;
-        for (int64_t i = 0; i < L; i++) {
+        for (int64_t i = 0; i < w->L; i++) {
             uint64_t h = row[i];
             if (h == SENT) continue;
             t++;
-            int64_t lo = 0, hi = H;
+            int64_t lo = 0, hi = w->H;
             while (lo < hi) {
                 int64_t mid = (lo + hi) >> 1;
-                if (ref[mid] < h)
+                if (w->ref[mid] < h)
                     lo = mid + 1;
                 else
                     hi = mid;
             }
-            if (lo < H && ref[lo] == h) m++;
+            if (lo < w->H && w->ref[lo] == h) m++;
         }
-        matched[w] = m;
-        total[w] = t;
+        w->matched[r] = m;
+        w->total[r] = t;
     }
+    return NULL;
+}
+
+void galah_window_match_counts(const uint64_t *wins, int64_t W,
+                               int64_t L, const uint64_t *ref,
+                               int64_t H, int n_threads,
+                               int32_t *matched, int32_t *total) {
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > 64) n_threads = 64;
+    if ((int64_t)n_threads > W) n_threads = W > 0 ? (int)W : 1;
+    wm_job jobs[64];
+    pthread_t tids[64];
+    for (int t = 0; t < n_threads; t++)
+        jobs[t] = (wm_job){wins, ref, W, L, H, matched, total,
+                           t, n_threads};
+    if (n_threads == 1) {
+        wm_worker(&jobs[0]);
+        return;
+    }
+    for (int t = 0; t < n_threads; t++)
+        pthread_create(&tids[t], NULL, wm_worker, &jobs[t]);
+    for (int t = 0; t < n_threads; t++)
+        pthread_join(tids[t], NULL);
 }
 
 /* Returns the TOTAL number of passing pairs (callers detect overflow by

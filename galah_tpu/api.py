@@ -137,7 +137,9 @@ def add_cluster_arguments(
                              "noisier per-fragment identity "
                              "(default: 1)")
     parser.add_argument(f"--{d.threads}", "-t", type=int, default=1,
-                        help="Host threads for FASTA stats/IO fan-out; "
+                        help="Host threads for FASTA stats/IO fan-out "
+                             "and CPU-backend native sketching/"
+                             "profiling; "
                              "device parallelism is managed by the mesh")
 
 
@@ -275,16 +277,16 @@ def generate_galah_clusterer(
         precluster_ani = ani
 
     store = ProfileStore(fraglen=fraglen, cache=cache,
-                         subsample_c=ani_subsample)
+                         subsample_c=ani_subsample, threads=threads)
     if pre_method == "finch":
         pre = MinHashPreclusterer(min_ani=precluster_ani, cache=cache,
-                                  hash_algo=hash_algo)
+                                  hash_algo=hash_algo, threads=threads)
     elif pre_method == "skani":
         pre = SkaniPreclusterer(threshold=precluster_ani,
                                 min_aligned_fraction=min_af, store=store)
     elif pre_method == "dashing":
         pre = HLLPreclusterer(min_ani=precluster_ani, cache=cache,
-                              hash_algo=hash_algo)
+                              hash_algo=hash_algo, threads=threads)
     else:
         raise ValueError(f"unknown precluster method {pre_method!r}")
 

@@ -61,10 +61,12 @@ class ProfileStore:
                  fraglen: int = Defaults.FRAGMENT_LENGTH,
                  maxsize: int = 128,
                  cache: Optional[diskcache.CacheDir] = None,
-                 subsample_c: int = Defaults.ANI_SUBSAMPLE) -> None:
+                 subsample_c: int = Defaults.ANI_SUBSAMPLE,
+                 threads: int = 1) -> None:
         self.k = k
         self.fraglen = fraglen
         self.subsample_c = int(subsample_c)
+        self.threads = max(int(threads), 1)
         self.maxsize = maxsize
         self.disk = cache or diskcache.get_cache()
         self._cache: "collections.OrderedDict[str, GenomeProfile]" = (
@@ -154,7 +156,8 @@ class ProfileStore:
         from galah_tpu.ops.hashing import device_transfer_bound
 
         for p, prof in process_stream(
-                iter_prefetched(misses, read_genome),
+                iter_prefetched(misses, read_genome,
+                                depth=max(2, self.threads)),
                 lambda g: g.codes.shape[0],
                 fragment_ani.PROFILE_BATCH_BUDGET,
                 lambda buf: fragment_ani.build_profiles_batch(
@@ -163,7 +166,8 @@ class ProfileStore:
                 lambda _path, g: fragment_ani.build_profile(
                     g, k=self.k, fraglen=self.fraglen,
                     subsample_c=self.subsample_c),
-                batched=device_transfer_bound()):
+                batched=device_transfer_bound(),
+                workers=self.threads):
             self._store_disk(p, prof)
             self._insert(p, prof)
             by_path[p] = prof
@@ -199,7 +203,8 @@ class _FragmentANIMixin:
             profs = [(by_path[a], by_path[b]) for a, b in pairs]
         with timing.stage("fragment-ani"):
             results = fragment_ani.bidirectional_ani_batch(
-                profs, min_aligned_frac=self.min_aligned_fraction)
+                profs, min_aligned_frac=self.min_aligned_fraction,
+                threads=self.store.threads)
         return [ani for ani, _, _ in results]
 
 
@@ -299,7 +304,8 @@ class SkaniPreclusterer(PreclusterBackend):
         cache = PairDistanceCache()
         results = fragment_ani.bidirectional_ani_batch(
             [(profiles[i], profiles[j]) for i, j in zip(ii, jj)],
-            min_aligned_frac=self.min_aligned_fraction)
+            min_aligned_frac=self.min_aligned_fraction,
+            threads=self.store.threads)
         for i, j, (ani, _, _) in zip(ii, jj, results):
             if ani is not None and ani >= self.threshold:
                 cache.insert((i, j), ani)

@@ -28,12 +28,14 @@ class HLLPreclusterer(PreclusterBackend):
                  k: int = Defaults.MINHASH_KMER,
                  seed: int = Defaults.MINHASH_SEED,
                  hash_algo: str = Defaults.HASH_ALGO,
-                 cache: "diskcache.CacheDir | None" = None) -> None:
+                 cache: "diskcache.CacheDir | None" = None,
+                 threads: int = 1) -> None:
         self.min_ani = float(min_ani)
         self.p = int(p)
         self.k = int(k)
         self.seed = int(seed)
         self.algo = hash_algo
+        self.threads = max(int(threads), 1)
         self.cache = cache or diskcache.get_cache()
 
     def method_name(self) -> str:
@@ -61,7 +63,8 @@ class HLLPreclusterer(PreclusterBackend):
                 return entry["regs"] if entry is not None else None
 
             hits, miss_iter = probe_and_prefetch(
-                genome_paths, probe, read_genome)
+                genome_paths, probe, read_genome,
+                depth=max(2, self.threads))
             for path, row in hits.items():
                 regs[index[path]] = row
             from galah_tpu.io.prefetch import process_stream
@@ -78,7 +81,8 @@ class HLLPreclusterer(PreclusterBackend):
                     lambda _path, g: hll.hll_sketch_genome(
                         g, p=self.p, k=self.k, seed=self.seed,
                         algo=self.algo),
-                    batched=device_transfer_bound()):
+                    batched=device_transfer_bound(),
+                    workers=self.threads):
                 regs[index[path]] = row
                 self.cache.store(path, "hll", params, {"regs": row})
 

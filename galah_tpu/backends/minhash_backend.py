@@ -104,10 +104,12 @@ class MinHashPreclusterer(PreclusterBackend):
         store: Optional[SketchStore] = None,
         cache: Optional[CacheDir] = None,
         hash_algo: str = Defaults.HASH_ALGO,
+        threads: int = 1,
     ) -> None:
         self.min_ani = float(min_ani)
         self.sketch_size = sketch_size
         self.k = k
+        self.threads = max(int(threads), 1)
         self.store = store or SketchStore(sketch_size, k, cache=cache,
                                           algo=hash_algo)
 
@@ -127,12 +129,14 @@ class MinHashPreclusterer(PreclusterBackend):
             # cache misses: ingestion prefetched on host threads while
             # the device sketches the previous genome
             by_path, miss_iter = probe_and_prefetch(
-                genome_paths, self.store.get_cached, read_genome)
+                genome_paths, self.store.get_cached, read_genome,
+                depth=max(2, self.threads))
             for p, s in process_stream(
                     miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
                     self.store.put_from_genomes,
                     self.store.put_from_genome,
-                    batched=hashing.device_transfer_bound()):
+                    batched=hashing.device_transfer_bound(),
+                    workers=self.threads):
                 by_path[p] = s
             sketches = [by_path[p] for p in genome_paths]
             mat = sketch_matrix(sketches, sketch_size=self.sketch_size)

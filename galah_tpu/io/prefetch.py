@@ -5,7 +5,9 @@ device dispatch; a bounded look-ahead pool keeps the next genomes'
 ingestion running while the device sketches the current one (the
 reference gets the same overlap from rayon's par_iter over files,
 reference: src/finch.rs:47 via sketch_files). Depth stays small so a
-50k-genome run never holds more than `depth` parsed genomes in memory.
+50k-genome run holds at most `depth` parsed genomes in memory — plus,
+when process_stream runs with workers > 1, up to 2*workers more in its
+in-flight window, so the bound is O(depth + workers), never O(N).
 """
 
 from __future__ import annotations
@@ -72,20 +74,49 @@ def process_stream(
     batch_fn: Callable[[list], list],
     single_fn: Callable[[str, T], V],
     batched: bool,
+    workers: int = 1,
 ) -> Iterator[Tuple[str, V]]:
     """Yield (path, result) for a (path, item) stream — through grouped
     `batch_fn(buffer) -> [result]` calls when `batched` (TPU backends,
     where dispatch round trips dominate), else per-item
     `single_fn(path, item)` (CPU backends, where per-genome chunks are
     cache-friendlier). The one gate/batch/store shape shared by the
-    three sketching backends."""
+    three sketching backends.
+
+    With workers > 1 (and not batched), single_fn runs on a thread pool
+    with a bounded in-flight window — the native C kernels release the
+    GIL, so multicore hosts sketch that many genomes concurrently
+    (results stream back in submission order)."""
     if batched:
         for buf in iter_batches(items, size_fn, budget):
             for (p, _), r in zip(buf, batch_fn(buf)):
                 yield p, r
+    elif workers > 1:
+        from collections import deque
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            it = iter(items)
+            pending: deque = deque()
+
+            def submit_next() -> bool:
+                try:
+                    p, item = next(it)
+                except StopIteration:
+                    return False
+                pending.append((p, pool.submit(single_fn, p, item)))
+                return True
+
+            for _ in range(2 * workers):
+                if not submit_next():
+                    break
+            while pending:
+                p, fut = pending.popleft()
+                result = fut.result()
+                submit_next()
+                yield p, result
     else:
-        for p, it in items:
-            yield p, single_fn(p, it)
+        for p, it_ in items:
+            yield p, single_fn(p, it_)
 
 
 def iter_prefetched(

@@ -8,7 +8,7 @@ Exposes
 the compiled-C twin of ops/pairwise.threshold_pairs for host CPUs —
 same f64 rational keep-check, same Mash ANI values (reference analog:
 the compiled pair loop of src/finch.rs:53-73). Build/load failures
-raise ImportError (cached by ops/_cbuild); set
+raise ImportError (cached by utils/cbuild); set
 GALAH_TPU_NO_CPAIRSTATS=1 to force callers' fallbacks.
 """
 
@@ -42,16 +42,18 @@ _fn_wm = _lib.galah_window_match_counts
 _fn_wm.restype = None
 _fn_wm.argtypes = [
     ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
-    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int,
     ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
 ]
 
 
-def window_match_counts(wins: np.ndarray,
-                        ref_set: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+def window_match_counts(
+        wins: np.ndarray, ref_set: np.ndarray,
+        threads: int = 1) -> "tuple[np.ndarray, np.ndarray]":
     """Per-window (matched, valid) counts of SENTINEL-masked hash
     windows against a sorted distinct reference set — C twin of
-    ops/fragment_ani._window_match_counts_impl."""
+    ops/fragment_ani._window_match_counts_impl, row-parallel over
+    `threads`."""
     wins = np.ascontiguousarray(wins, dtype=np.uint64)
     ref_set = np.ascontiguousarray(ref_set, dtype=np.uint64)
     if wins.ndim != 2:
@@ -64,7 +66,7 @@ def window_match_counts(wins: np.ndarray,
     _fn_wm(wins.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
            w, wins.shape[1],
            ref_set.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-           ref_set.shape[0],
+           ref_set.shape[0], max(int(threads), 1),
            matched.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
            total.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     return matched, total

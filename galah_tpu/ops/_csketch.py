@@ -11,7 +11,7 @@ bit-identical to the JAX pipelines (ops/minhash.py,
 ops/fragment_ani.py) for both hash algorithms and full 64-bit seeds —
 the CPU-backend fast path for sketching (reference analog: finch's
 compiled sketching, src/finch.rs:33-47). Build/load failures raise
-ImportError (cached by ops/_cbuild); set GALAH_TPU_NO_CSKETCH=1 to
+ImportError (cached by utils/cbuild); set GALAH_TPU_NO_CSKETCH=1 to
 force the JAX path.
 """
 

@@ -372,6 +372,7 @@ def directed_ani_batch(
     queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
     identity_floor: float = 0.80,
     min_window_valid_frac: float = 0.5,
+    threads: int = 1,
 ) -> "list[DirectedANI]":
     """Directed fragment ANI for many (query, ref) pairs, coalescing
     device dispatches.
@@ -397,7 +398,8 @@ def directed_ani_batch(
         if window_match_counts is not None:
             return [
                 _directed_from_counts(
-                    *window_match_counts(q.windows(), r.ref_set),
+                    *window_match_counts(q.windows(), r.ref_set,
+                                         threads=threads),
                     q, identity_floor, min_window_valid_frac)
                 for q, r in queries
             ]
@@ -484,6 +486,7 @@ def bidirectional_ani_batch(
     pairs: "list[Tuple[GenomeProfile, GenomeProfile]]",
     min_aligned_frac: float,
     identity_floor: float = 0.80,
+    threads: int = 1,
 ) -> "list[Tuple[Optional[float], DirectedANI, DirectedANI]]":
     """Batched twin of `bidirectional_ani`: both directions of every pair
     go through one `directed_ani_batch` call; the gate/max semantics per
@@ -492,7 +495,7 @@ def bidirectional_ani_batch(
         _check_same_subsample(a, b)
     directed = directed_ani_batch(
         [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs],
-        identity_floor=identity_floor)
+        identity_floor=identity_floor, threads=threads)
     n = len(pairs)
     out = []
     for i in range(n):

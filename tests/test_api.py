@@ -93,3 +93,31 @@ def test_degenerate_genomes_cluster_alone(tmp_path, pre):
               "threads": 1}
     clusters = generate_galah_clusterer(paths, values).cluster()
     assert sorted(sorted(c) for c in clusters) == [[0], [1], [2]]
+
+
+def test_threads_parity_clusters(tmp_path):
+    """--threads N produces identical clusters to --threads 1 (the
+    threaded CPU sketch/profile fan-out is order-independent)."""
+    import numpy as np
+
+    from galah_tpu.api import generate_galah_clusterer
+
+    rng = np.random.default_rng(41)
+    paths = []
+    for f in range(3):
+        base = rng.integers(0, 4, size=30_000)
+        for m in range(2):
+            seq = base.copy()
+            if m:
+                sites = rng.random(seq.shape[0]) < 0.02
+                seq[sites] = (seq[sites]
+                              + rng.integers(1, 4, size=int(sites.sum()))) % 4
+            p = tmp_path / f"f{f}m{m}.fna"
+            p.write_text(">c\n" + "".join("ACGT"[c] for c in seq) + "\n")
+            paths.append(str(p))
+    values = {"ani": 95.0, "precluster_ani": 90.0,
+              "min_aligned_fraction": 15.0, "fragment_length": 3000,
+              "precluster_method": "finch", "cluster_method": "skani"}
+    one = generate_galah_clusterer(paths, {**values, "threads": 1}).cluster()
+    many = generate_galah_clusterer(paths, {**values, "threads": 3}).cluster()
+    assert sorted(map(sorted, one)) == sorted(map(sorted, many))

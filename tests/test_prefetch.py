@@ -67,3 +67,35 @@ def test_iter_batches_budget_and_cap():
 
     # empty stream
     assert list(iter_batches(iter([]), lambda v: v, budget=1)) == []
+
+
+def test_process_stream_workers_parity():
+    from galah_tpu.io.prefetch import process_stream
+
+    items = [(f"p{i}", i) for i in range(17)]
+    serial = dict(process_stream(
+        iter(items), lambda v: 1, 10**9,
+        batch_fn=None, single_fn=lambda p, v: v * v, batched=False))
+    threaded = dict(process_stream(
+        iter(items), lambda v: 1, 10**9,
+        batch_fn=None, single_fn=lambda p, v: v * v, batched=False,
+        workers=4))
+    assert serial == threaded == {f"p{i}": i * i for i in range(17)}
+
+
+def test_process_stream_workers_propagates_errors():
+    from galah_tpu.io.prefetch import process_stream
+
+    def boom(p, v):
+        if v == 5:
+            raise RuntimeError("x")
+        return v
+
+    items = [(f"p{i}", i) for i in range(8)]
+    try:
+        list(process_stream(iter(items), lambda v: 1, 10**9, None,
+                            boom, batched=False, workers=3))
+    except RuntimeError as e:
+        assert str(e) == "x"
+    else:
+        raise AssertionError("expected RuntimeError")
