@@ -147,6 +147,65 @@ static inline void bk_add(bk_acc *acc, uint64_t h) {
     if (acc->n_cand >= acc->cap - acc->size) bk_compact(acc);
 }
 
+/* ---------------- shared canonical-window walker ------------------- */
+
+/* One definition of the rolling canonical-k-mer iteration shared by the
+ * bottom-k sketcher, the positional hasher, and the HLL fold: O(1)
+ * rolling 2-bit packs, ambiguous-run and contig-crossing skipping,
+ * canonical (min of forward/revcomp) key hashing with the selected
+ * algo. Inside the statement hooks, WPOS is the window start index and
+ * WHASH the canonical hash; VALID_STMT runs per valid window,
+ * INVALID_STMT per invalid window position (both only for WPOS >= 0). */
+#define GALAH_WALK(codes, n, offsets, n_offsets, k, seed, algo,        \
+                   VALID_STMT, INVALID_STMT)                           \
+    do {                                                               \
+        const uint64_t mask_ =                                         \
+            (k) < 32 ? (1ull << (2 * (k))) - 1 : ~0ull;                \
+        const int shift_hi_ = 2 * ((k) - 1);                           \
+        static const char ASCII_[4] = {'A', 'C', 'G', 'T'};            \
+        const int64_t *interior_ = (offsets) + 1;                      \
+        int64_t n_int_ = (n_offsets) >= 2 ? (n_offsets) - 2 : 0;       \
+        int64_t bptr_ = 0;                                             \
+        uint64_t fwd_ = 0, rev_ = 0;                                   \
+        int valid_run_ = 0;                                            \
+        uint8_t keybuf_[32];                                           \
+        for (int64_t i_ = 0; i_ < (n); i_++) {                         \
+            uint8_t c_ = (codes)[i_];                                  \
+            int64_t WPOS = i_ - (k) + 1;                               \
+            if (c_ > 3) {                                              \
+                valid_run_ = 0;                                        \
+            } else {                                                   \
+                valid_run_++;                                          \
+                fwd_ = ((fwd_ << 2) | c_) & mask_;                     \
+                rev_ = (rev_ >> 2) |                                   \
+                       ((uint64_t)(3 - c_) << shift_hi_);              \
+            }                                                          \
+            if (WPOS < 0) continue;                                    \
+            int invalid_ = valid_run_ < (k);                           \
+            if (!invalid_) {                                           \
+                while (bptr_ < n_int_ && interior_[bptr_] <= WPOS)     \
+                    bptr_++;                                           \
+                invalid_ = bptr_ < n_int_ &&                           \
+                           interior_[bptr_] < WPOS + (k);              \
+            }                                                          \
+            if (invalid_) {                                            \
+                INVALID_STMT;                                          \
+                continue;                                              \
+            }                                                          \
+            uint64_t canon_ = fwd_ <= rev_ ? fwd_ : rev_;              \
+            uint64_t WHASH;                                            \
+            if ((algo) == 1) {                                         \
+                WHASH = tpufast_mix(canon_, (seed));                   \
+            } else {                                                   \
+                for (int b_ = 0; b_ < (k); b_++)                       \
+                    keybuf_[b_] = (uint8_t)ASCII_[                     \
+                        (canon_ >> (2 * ((k) - 1 - b_))) & 3];         \
+                WHASH = murmur3_x64_128_h1(keybuf_, (k), (seed));      \
+            }                                                          \
+            VALID_STMT;                                                \
+        }                                                              \
+    } while (0)
+
 /* ---------------- positional hashes -------------------------------- */
 
 /* Every window's canonical hash in genome order; invalid windows
@@ -159,48 +218,43 @@ int64_t galah_positional_hashes(const uint8_t *codes, int64_t n,
                                 int algo, uint64_t *out) {
     if (n < k || k < 1 || k > 32) return 0;
     const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
-    const uint64_t mask = k < 32 ? (1ull << (2 * k)) - 1 : ~0ull;
-    const int shift_hi = 2 * (k - 1);
-    static const char ASCII[4] = {'A', 'C', 'G', 'T'};
-    const int64_t *interior = offsets + 1;
-    int64_t n_int = n_offsets >= 2 ? n_offsets - 2 : 0;
-    int64_t bptr = 0;
-    uint64_t fwd = 0, rev = 0;
-    int valid_run = 0;
-    uint8_t keybuf[32];
-    int64_t n_win = n - k + 1;
+    GALAH_WALK(codes, n, offsets, n_offsets, k, seed, algo,
+               out[WPOS] = WHASH, out[WPOS] = SENT);
+    return n - k + 1;
+}
 
-    for (int64_t i = 0; i < n; i++) {
-        uint8_t c = codes[i];
-        int64_t p = i - k + 1;
-        if (c > 3) {
-            valid_run = 0;
-        } else {
-            valid_run++;
-            fwd = ((fwd << 2) | c) & mask;
-            rev = (rev >> 2) | ((uint64_t)(3 - c) << shift_hi);
-        }
-        if (p < 0) continue;
-        if (valid_run < k) {
-            out[p] = SENT;
-            continue;
-        }
-        while (bptr < n_int && interior[bptr] <= p) bptr++;
-        if (bptr < n_int && interior[bptr] < p + k) {
-            out[p] = SENT;
-            continue;
-        }
-        uint64_t canon = fwd <= rev ? fwd : rev;
-        if (algo == 1) {
-            out[p] = tpufast_mix(canon, seed);
-        } else {
-            for (int b = 0; b < k; b++)
-                keybuf[b] =
-                    (uint8_t)ASCII[(canon >> (2 * (k - 1 - b))) & 3];
-            out[p] = murmur3_x64_128_h1(keybuf, k, seed);
-        }
-    }
-    return n_win;
+/* ---------------- HLL registers ------------------------------------ */
+
+/* 2^p uint8 HyperLogLog registers over the genome's canonical k-mer
+ * hashes — C twin of ops/hll.hll_sketch_genome: register index = top p
+ * bits, rho = leading zeros of the remaining bits + 1 (capped at
+ * 64 - p + 1), registers take the max. regs must be zeroed by the
+ * caller. Returns 0. */
+int64_t galah_hll_registers(const uint8_t *codes, int64_t n,
+                            const int64_t *offsets, int64_t n_offsets,
+                            int k, int p, uint64_t seed, int algo,
+                            uint8_t *regs) {
+    if (n < k || k < 1 || k > 32 || p < 1 || p > 24) return 0;
+    const uint8_t rho_cap = (uint8_t)(64 - p + 1);
+    GALAH_WALK(
+        codes, n, offsets, n_offsets, k, seed, algo,
+        {
+            uint64_t idx = WHASH >> (64 - p);
+            uint64_t rest = WHASH << p;
+            uint8_t rho = 1;
+            if (rest == 0) {
+                rho = rho_cap;
+            } else {
+                while (!(rest >> 63)) {
+                    rest <<= 1;
+                    rho++;
+                }
+                if (rho > rho_cap) rho = rho_cap;
+            }
+            if (rho > regs[idx]) regs[idx] = rho;
+        },
+        (void)0);
+    return 0;
 }
 
 /* ---------------- main entry --------------------------------------- */
@@ -229,44 +283,8 @@ int64_t galah_sketch_bottomk(const uint8_t *codes, int64_t n,
         return -1;
     }
 
-    const uint64_t mask = k < 32 ? (1ull << (2 * k)) - 1 : ~0ull;
-    const int shift_hi = 2 * (k - 1);
-    static const char ASCII[4] = {'A', 'C', 'G', 'T'};
-
-    /* interior contig boundaries (exclude 0 and n) */
-    const int64_t *interior = offsets + 1;
-    int64_t n_int = n_offsets >= 2 ? n_offsets - 2 : 0;
-    int64_t bptr = 0;
-
-    uint64_t fwd = 0, rev = 0;
-    int valid_run = 0; /* consecutive non-ambiguous codes ending here */
-    uint8_t keybuf[32];
-
-    for (int64_t i = 0; i < n; i++) {
-        uint8_t c = codes[i];
-        if (c > 3) {
-            valid_run = 0;
-            continue;
-        }
-        valid_run++;
-        fwd = ((fwd << 2) | c) & mask;
-        rev = (rev >> 2) | ((uint64_t)(3 - c) << shift_hi);
-        if (valid_run < k) continue;
-        int64_t p = i - k + 1; /* window start */
-        while (bptr < n_int && interior[bptr] <= p) bptr++;
-        if (bptr < n_int && interior[bptr] < p + k) continue;
-        uint64_t canon = fwd <= rev ? fwd : rev;
-        uint64_t h;
-        if (algo == 1) {
-            h = tpufast_mix(canon, seed);
-        } else {
-            for (int b = 0; b < k; b++)
-                keybuf[b] =
-                    (uint8_t)ASCII[(canon >> (2 * (k - 1 - b))) & 3];
-            h = murmur3_x64_128_h1(keybuf, k, seed);
-        }
-        bk_add(&acc, h);
-    }
+    GALAH_WALK(codes, n, offsets, n_offsets, k, seed, algo,
+               bk_add(&acc, WHASH), (void)0);
     bk_compact(&acc);
     int64_t out_n = acc.n_sketch;
     memcpy(out, acc.sketch, (size_t)out_n * 8);

@@ -49,6 +49,35 @@ _fn_pos.argtypes = [
 ]
 
 
+_fn_hll = _lib.galah_hll_registers
+_fn_hll.restype = ctypes.c_int64
+_fn_hll.argtypes = [
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_uint8),
+]
+
+
+def hll_registers(codes: np.ndarray, contig_offsets, k: int, p: int,
+                  seed: int, algo: str) -> np.ndarray:
+    """(2^p,) uint8 HLL registers over the genome's canonical k-mers —
+    C twin of ops/hll.hll_sketch_genome."""
+    _check(algo, k)
+    if not 1 <= p <= 24:
+        raise ValueError(f"p must be in [1, 24], got {p}")
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    offs = np.ascontiguousarray(contig_offsets, dtype=np.int64)
+    regs = np.zeros(1 << p, dtype=np.uint8)
+    _fn_hll(codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            codes.shape[0],
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            offs.shape[0], int(k), int(p),
+            int(seed) & 0xFFFFFFFFFFFFFFFF, _ALGOS[algo],
+            regs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return regs
+
+
 def _check(algo: str, k: int) -> None:
     if algo not in _ALGOS:
         raise ValueError(f"unknown hash algorithm {algo!r}")

@@ -69,7 +69,21 @@ def hll_sketch_genome(
     chunk: int = hashing.DEFAULT_CHUNK,
     algo: str = "murmur3",
 ) -> np.ndarray:
-    """(2^p,) uint8 HLL registers over the genome's canonical k-mers."""
+    """(2^p,) uint8 HLL registers over the genome's canonical k-mers.
+
+    On a single-process CPU backend the compiled-C walker runs instead
+    (csrc/sketch.c::galah_hll_registers, bit-identical); an explicit
+    non-default chunk pins the JAX path."""
+    if (jax.default_backend() == "cpu" and k <= 32 and 1 <= p <= 24
+            and chunk == hashing.DEFAULT_CHUNK):
+        try:
+            from galah_tpu.ops import _csketch
+
+            return _csketch.hll_registers(
+                genome.codes, genome.contig_offsets, k=k, p=p,
+                seed=seed, algo=algo)
+        except ImportError:
+            pass  # no C toolchain: fall through to the JAX path
     regs = jnp.zeros((1 << p,), dtype=jnp.uint8)
     for hashes, _pos, _n_new in hashing.iter_chunk_hashes(
             genome.codes, genome.contig_offsets, k=k, chunk=chunk,

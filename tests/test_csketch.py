@@ -107,3 +107,22 @@ def test_c_64bit_seed_parity(tmp_path):
         got = csk.sketch_bottomk(g.codes, g.contig_offsets, k=21,
                                  sketch_size=64, seed=big, algo=algo)
         np.testing.assert_array_equal(want.hashes, got)
+
+
+def test_c_hll_registers_match_jax(tmp_path):
+    """C HLL registers equal the JAX chunk pipeline bit-for-bit (both
+    algos, N masking, contig break)."""
+    from galah_tpu.ops import hll
+
+    rng = np.random.default_rng(14)
+    seq = "".join(rng.choice(list("ACGT"), size=25_000))
+    g = _write(tmp_path, "h.fna",
+               f">a\n{seq[:9000]}N{seq[9000:]}\n>b\n{seq[:70]}\n")
+    for algo in ("murmur3", "tpufast"):
+        want = hll.hll_sketch_genome(g, p=10, algo=algo, chunk=2048)
+        got = csk.hll_registers(g.codes, g.contig_offsets, k=21, p=10,
+                                seed=0, algo=algo)
+        np.testing.assert_array_equal(np.asarray(want), got)
+        # and the default path selects the C twin with identical output
+        np.testing.assert_array_equal(
+            np.asarray(hll.hll_sketch_genome(g, p=10, algo=algo)), got)
