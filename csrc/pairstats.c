@@ -99,6 +99,68 @@ static void *worker(void *arg) {
     return NULL;
 }
 
+/* Exact merged-bottom-k stats for an EXPLICIT pair list (the sparse
+ * screened path): for each (pi[x], pj[x]) run the same merge walk and
+ * f64 rational keep-check as the all-pairs kernel; out_ani[x] = ANI for
+ * keepers, -inf for non-keepers (a real ANI is always finite). Pairs
+ * are split across threads. */
+
+typedef struct {
+    const uint64_t *mat;
+    const int64_t *lens, *pi, *pj;
+    int64_t n_pairs, width;
+    int sketch_size, kmer;
+    double j_thr;
+    int tid, n_threads;
+    double *out_ani;
+} pl_job;
+
+static void *pl_worker(void *arg) {
+    pl_job *w = (pl_job *)arg;
+    for (int64_t x = w->tid; x < w->n_pairs; x += w->n_threads) {
+        int64_t i = w->pi[x], j = w->pj[x];
+        int64_t common, total;
+        pair_stats(w->mat + i * w->width, w->lens[i],
+                   w->mat + j * w->width, w->lens[j], w->sketch_size,
+                   &common, &total);
+        if (total == 0 ||
+            (double)common < w->j_thr * (double)total) {
+            w->out_ani[x] = -HUGE_VAL; /* impossible ANI = rejected */
+            continue;
+        }
+        double jac = (double)common / (double)total;
+        w->out_ani[x] =
+            common > 0
+                ? 1.0 - (-log(2.0 * jac / (1.0 + jac)) /
+                         (double)w->kmer)
+                : 0.0;
+    }
+    return NULL;
+}
+
+void galah_pair_stats_for_pairs(
+    const uint64_t *mat, int64_t n_pairs, int64_t width,
+    const int64_t *lens, const int64_t *pi, const int64_t *pj,
+    int sketch_size, int kmer, double j_thr, int n_threads,
+    double *out_ani) {
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > 64) n_threads = 64;
+    pl_job jobs[64];
+    pthread_t tids[64];
+    for (int t = 0; t < n_threads; t++)
+        jobs[t] = (pl_job){mat, lens, pi, pj, n_pairs, width,
+                           sketch_size, kmer, j_thr, t, n_threads,
+                           out_ani};
+    if (n_threads == 1) {
+        pl_worker(&jobs[0]);
+        return;
+    }
+    for (int t = 0; t < n_threads; t++)
+        pthread_create(&tids[t], NULL, pl_worker, &jobs[t]);
+    for (int t = 0; t < n_threads; t++)
+        pthread_join(tids[t], NULL);
+}
+
 /* Per-window fragment membership counts: for each row of `wins`
  * (SENTINEL-masked positional hash windows, ops/fragment_ani
  * GenomeProfile.windows layout), count valid hashes and how many are

@@ -164,3 +164,76 @@ def test_window_match_counts_matches_jax(tmp_path):
         m_c, t_c, q, 0.80, 0.5)
     assert out[0].frags_matching == one.frags_matching
     assert out[0].ani == pytest.approx(one.ani)
+
+
+def test_sparse_screen_matches_dense(monkeypatch):
+    """The inverted-index screened path returns exactly the dense
+    result on family-structured sketches above the size cutoff."""
+    rng = np.random.default_rng(33)
+    n, k_sketch, kmer = 1200, 64, 21
+    n_fam = 100
+    base = rng.integers(0, 1 << 62, size=(n_fam, k_sketch),
+                        dtype=np.uint64)
+    mat = np.empty((n, k_sketch), dtype=np.uint64)
+    for i in range(n):
+        fam = i % n_fam
+        row = base[fam].copy()
+        # perturb a random subset so within-family jaccard varies
+        n_mut = rng.integers(0, 20)
+        idx = rng.choice(k_sketch, size=n_mut, replace=False)
+        row[idx] = rng.integers(0, 1 << 62, size=n_mut, dtype=np.uint64)
+        row.sort()
+        mat[i] = row
+    # a couple of ragged + empty rows
+    mat[7, 32:] = np.uint64(SENTINEL)
+    mat[11] = np.uint64(SENTINEL)
+    mat.sort(axis=1)
+
+    assert n >= cps.SPARSE_SCREEN_MIN_N
+    sparse = cps.threshold_pairs_c(mat, k_sketch, kmer, 0.95)
+    monkeypatch.setenv("GALAH_TPU_DENSE_PAIRS", "1")
+    dense = cps.threshold_pairs_c(mat, k_sketch, kmer, 0.95)
+    assert sparse == dense
+    assert len(dense) > 100  # the families really do produce pairs
+
+
+def test_sparse_screen_low_threshold(monkeypatch):
+    """Conservativeness at a low threshold (weak screen bound): a small
+    hash space forces genuine chance collisions, so partial overlaps
+    near the count bound are actually exercised."""
+    rng = np.random.default_rng(35)
+    n, k_sketch = 1100, 32
+    # 2^13 hash space, distinct within each row: cross-row collisions
+    # abound, and at this threshold a single shared hash passes
+    mat = np.stack([
+        np.sort(rng.choice(1 << 13, size=k_sketch,
+                           replace=False)).astype(np.uint64)
+        for _ in range(n)
+    ])
+    sparse = cps.threshold_pairs_c(mat, k_sketch, 21, 0.7)
+    monkeypatch.setenv("GALAH_TPU_DENSE_PAIRS", "1")
+    dense = cps.threshold_pairs_c(mat, k_sketch, 21, 0.7)
+    assert sparse == dense
+    assert dense, "collision-rich matrix must produce passing pairs"
+
+
+def test_sparse_screen_big_runs(monkeypatch):
+    """Near-duplicate clusters (collision runs > _BIG_RUN genomes) take
+    the dedup-group path: identical results, no O(K*m^2) blowup."""
+    rng = np.random.default_rng(37)
+    n, k_sketch = 1300, 48
+    base = np.sort(rng.integers(0, 1 << 62, size=k_sketch,
+                                dtype=np.uint64))
+    mat = np.tile(base, (n, 1))
+    # 200 rows perturbed lightly; the other 1100 are identical
+    for i in range(200):
+        row = base.copy()
+        idx = rng.choice(k_sketch, size=3, replace=False)
+        row[idx] = rng.integers(0, 1 << 62, size=3, dtype=np.uint64)
+        row.sort()
+        mat[i] = row
+    sparse = cps.threshold_pairs_c(mat, k_sketch, 21, 0.9)
+    monkeypatch.setenv("GALAH_TPU_DENSE_PAIRS", "1")
+    dense = cps.threshold_pairs_c(mat, k_sketch, 21, 0.9)
+    assert sparse == dense
+    assert len(dense) >= 1100 * 1099 // 2
