@@ -81,93 +81,28 @@ _fn_pl.argtypes = [
     ctypes.c_double, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
 ]
 
-# Above this genome count the sparse inverted-index screen replaces the
-# dense O(N^2) walk (below it, the dense walk is cheaper than sorting
-# the whole hash multiset). GALAH_TPU_DENSE_PAIRS=1 forces dense.
-SPARSE_SCREEN_MIN_N = 1024
-
-# Collision runs longer than this skip exact counting (see
-# _candidate_pairs_sparse).
-_BIG_RUN = 64
+# Crossover/env policy lives with the collision counter; re-exported
+# for existing importers.
+from galah_tpu.ops.collision import SPARSE_SCREEN_MIN_N  # noqa: E402
 
 
 def _candidate_pairs_sparse(mat: np.ndarray, lens: np.ndarray,
                             j_thr: float, sketch_size: int):
-    """Conservative candidate pairs by hash-collision counting.
-
-    Sort the (hash, genome) multiset of every sketch entry; each run of
-    equal hashes contributes a collision to all genome pairs in the
-    run. The per-pair collision count equals |A ∩ B| over the FULL
-    sketches (rows hold distinct values by construction), which upper-
-    bounds the merge walk's `common`, while its `total` is at least
-    t_min = min(sketch_size, max(|A|, |B|)) — so any pair failing
-    count >= j_thr * t_min - 1 provably fails the exact keep-check and
-    is skipped. Survivors (plus a safety margin of one count) get the
-    exact walk; results are bit-identical to the dense path.
-
-    Complexity: O(NK log NK + collisions) instead of O(N^2 K) — the
-    same screening idea skani applies with marker sketches (reference:
-    src/skani.rs:54-70), here over the MinHash entries themselves.
+    """Conservative candidate pairs by hash-collision counting
+    (ops/collision.py). The exact per-pair |A ∩ B| upper-bounds the
+    merge walk's `common`, while its `total` is at least
+    t_min = min(sketch_size, max(|A|, |B|)) — so any pair with
+    count < j_thr * t_min provably fails the exact keep-check and is
+    skipped. Survivors get the exact walk; results are bit-identical
+    to the dense path.
     """
-    n = mat.shape[0]
-    ids = np.repeat(np.arange(n, dtype=np.int64), lens)
-    hv = mat[mat != np.uint64(SENTINEL)]
-    order = np.argsort(hv, kind="stable")
-    hs = hv[order]
-    gs = ids[order]
-    if hs.shape[0] == 0:
-        return np.zeros(0, np.int64), np.zeros(0, np.int64)
-    starts = np.flatnonzero(
-        np.concatenate([[True], hs[1:] != hs[:-1]]))
-    run_len = np.diff(np.append(starts, hs.shape[0]))
+    from galah_tpu.ops.collision import collision_pair_counts
 
-    # A hash shared by m genomes contributes m*(m-1)/2 collisions. For
-    # small m that is counted exactly; for m > _BIG_RUN the group's
-    # genomes are near-duplicates whose pairwise keys would repeat for
-    # ~every shared hash (O(K * m^2) intermediate memory), so instead
-    # each DISTINCT big group emits its pairs ONCE as unconditional
-    # candidates — conservative (more candidates only cost exact-walk
-    # time, never correctness) and bounded by O(K*m + pairs-out).
-    pair_keys = []
-    big_pair_keys: "set[bytes] | list" = []
-    seen_groups: "set[bytes]" = set()
-    big_mask = run_len > _BIG_RUN
-    for s, m in zip(starts[big_mask], run_len[big_mask]):
-        group = np.unique(gs[s:s + m])
-        sig = group.tobytes()
-        if sig in seen_groups:
-            continue
-        seen_groups.add(sig)
-        gi = group[:, None]
-        gj = group[None, :]
-        keys = (gi * n + gj)[gi < gj]
-        big_pair_keys.append(keys)
-    for m in np.unique(run_len[~big_mask]):
-        if m < 2:
-            continue
-        s = starts[(run_len == m) & ~big_mask]
-        block = gs[s[:, None] + np.arange(m)]
-        block.sort(axis=1)
-        for a in range(int(m)):
-            for b in range(a + 1, int(m)):
-                i, j = block[:, a], block[:, b]
-                neq = i != j  # duplicate genome paths share rows
-                pair_keys.append(i[neq] * n + j[neq])
-    if pair_keys:
-        keys, counts = np.unique(np.concatenate(pair_keys),
-                                 return_counts=True)
-        pi = keys // n
-        pj = keys % n
-        t_min = np.minimum(
-            sketch_size,
-            np.maximum(lens[pi], lens[pj])).astype(np.float64)
-        keep = counts.astype(np.float64) >= j_thr * t_min - 1.0
-        keys = keys[keep]
-    else:
-        keys = np.zeros(0, np.int64)
-    if big_pair_keys:
-        keys = np.unique(np.concatenate([keys, *big_pair_keys]))
-    return keys // n, keys % n
+    pi, pj, counts = collision_pair_counts(mat, lens)
+    t_min = np.minimum(
+        sketch_size, np.maximum(lens[pi], lens[pj])).astype(np.float64)
+    keep = counts.astype(np.float64) >= j_thr * t_min - 1e-9
+    return pi[keep], pj[keep]
 
 
 def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,

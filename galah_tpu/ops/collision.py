@@ -1,0 +1,127 @@
+"""Inverted-index collision counting over sketch/marker matrices.
+
+Pure numpy (no C toolchain required): sort the (hash, genome) multiset
+of every valid entry; each run of equal hashes contributes one
+collision to every genome pair in the run. Because rows hold DISTINCT
+values by construction (bottom-k sketches, marker sets), the per-pair
+collision count equals |A ∩ B| over the full rows — exactly.
+
+This replaces O(N^2) all-pairs passes with
+O(NK log NK + collision pairs) whenever similarity is sparse — the
+same screening idea the reference's skani applies with marker sketches
+(reference: src/skani.rs:54-70), generalized to any of this
+framework's row sets. Consumers:
+
+  * ops/_cpairstats.threshold_pairs_c — conservative MinHash screen
+    (count upper-bounds the merge walk's `common`), survivors get the
+    exact C walk;
+  * ops/pairwise.screen_pairs — the marker-containment screen, where
+    count IS the containment numerator, so the host check is exact
+    with no second pass.
+
+Near-duplicate mega-clusters (a hash shared by > _BIG_RUN genomes)
+would emit the same group's pairs for ~every shared hash; such runs
+are deduplicated by group signature and their occurrence counts added
+per distinct group, keeping the work O(K*m + output pairs) instead of
+O(K*m^2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from galah_tpu.ops.constants import SENTINEL
+
+_BIG_RUN = 64
+
+# Above this genome count the sparse collision screens replace the
+# dense O(N^2) passes (below it, dense is cheaper than sorting the
+# whole hash multiset). GALAH_TPU_DENSE_PAIRS=1 forces dense.
+SPARSE_SCREEN_MIN_N = 1024
+
+# Emitted-key buffer compaction threshold: peak transient memory is
+# O(this + distinct pairs), never O(total emissions) — mid-size
+# families (2.._BIG_RUN members sharing most hashes) emit the same
+# pair key once per shared hash, which would otherwise concatenate to
+# multi-GB before the final unique.
+_COMPACT_EVERY = 4 << 20
+
+
+class _CountAccum:
+    """Incrementally merge (key, weight) batches into exact per-key
+    sums, compacting whenever the buffer exceeds _COMPACT_EVERY."""
+
+    def __init__(self) -> None:
+        self._keys = [np.zeros(0, np.int64)]
+        self._weights = [np.zeros(0, np.int64)]
+        self._buffered = 0
+
+    def add(self, keys: np.ndarray, weights: np.ndarray) -> None:
+        if keys.shape[0] == 0:
+            return
+        self._keys.append(keys)
+        self._weights.append(weights)
+        self._buffered += keys.shape[0]
+        if self._buffered > _COMPACT_EVERY:
+            self.compact()
+
+    def compact(self) -> "tuple[np.ndarray, np.ndarray]":
+        keys = np.concatenate(self._keys)
+        weights = np.concatenate(self._weights)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        sums = np.bincount(inv, weights=weights).astype(np.int64)
+        self._keys = [uniq]
+        self._weights = [sums]
+        self._buffered = 0
+        return uniq, sums
+
+
+def collision_pair_counts(mat: np.ndarray, lens: np.ndarray):
+    """Exact |A ∩ B| for every colliding row pair of a SENTINEL-padded
+    sorted matrix with per-row valid lengths.
+
+    Returns (pi, pj, counts) with pi < pj, int64. Pairs with zero
+    collisions are not enumerated.
+    """
+    n = mat.shape[0]
+    ids = np.repeat(np.arange(n, dtype=np.int64), lens)
+    hv = mat[mat != np.uint64(SENTINEL)]
+    order = np.argsort(hv, kind="stable")
+    hs = hv[order]
+    gs = ids[order]
+    empty = (np.zeros(0, np.int64),) * 3
+    if hs.shape[0] == 0:
+        return empty
+    starts = np.flatnonzero(np.concatenate([[True], hs[1:] != hs[:-1]]))
+    run_len = np.diff(np.append(starts, hs.shape[0]))
+
+    acc = _CountAccum()
+    big_mask = run_len > _BIG_RUN
+    groups: "dict[bytes, tuple[np.ndarray, int]]" = {}
+    for s, m in zip(starts[big_mask], run_len[big_mask]):
+        group = np.unique(gs[s:s + m])
+        sig = group.tobytes()
+        prev = groups.get(sig)
+        groups[sig] = (group, (prev[1] if prev else 0) + 1)
+    for group, occurrences in groups.values():
+        gi = group[:, None]
+        gj = group[None, :]
+        keys = (gi * n + gj)[gi < gj]
+        acc.add(keys,
+                np.full(keys.shape[0], occurrences, dtype=np.int64))
+    for m in np.unique(run_len[~big_mask]):
+        if m < 2:
+            continue
+        s = starts[(run_len == m) & ~big_mask]
+        block = gs[s[:, None] + np.arange(m)]
+        block.sort(axis=1)
+        for a in range(int(m)):
+            for b in range(a + 1, int(m)):
+                i, j = block[:, a], block[:, b]
+                neq = i != j  # duplicate genome paths share rows
+                acc.add(i[neq] * n + j[neq],
+                        np.ones(int(neq.sum()), dtype=np.int64))
+    uniq, counts = acc.compact()
+    if uniq.shape[0] == 0:
+        return empty
+    return uniq // n, uniq % n, counts

@@ -23,6 +23,7 @@ bit-compatible with ops/minhash_np.py and the reference's finch backend
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -346,6 +347,29 @@ def screen_pairs(
     multi-device runtime the column-sharded SPMD twin
     (parallel/mesh.sharded_screen_pairs) is selected automatically.
     """
+    # Single-device CPU with no knobs pinned: the inverted-index
+    # collision counts ARE the containment numerators (marker sets are
+    # distinct), so the host check below is exact with no second pass —
+    # O(NM log NM + colliding pairs) instead of O(N^2) tiles. The
+    # denom > 0 guard matches the tiled paths (see _screen_pairs_single).
+    from galah_tpu.ops.collision import SPARSE_SCREEN_MIN_N
+
+    if (mesh is None and use_pallas is None and row_tile is None
+            and col_tile is None
+            and marker_mat.shape[0] >= SPARSE_SCREEN_MIN_N
+            and not os.environ.get("GALAH_TPU_DENSE_PAIRS")
+            and jax.default_backend() == "cpu"
+            and jax.device_count() == 1):
+        from galah_tpu.ops.collision import collision_pair_counts
+
+        counts64 = np.asarray(counts, dtype=np.int64)
+        pi, pj, inter = collision_pair_counts(
+            np.ascontiguousarray(marker_mat, dtype=np.uint64), counts64)
+        denom = np.minimum(counts64[pi], counts64[pj]).astype(np.float64)
+        keep = (denom > 0) & (inter.astype(np.float64)
+                              >= c_floor * denom)
+        return list(zip(pi[keep].tolist(), pj[keep].tolist()))
+
     if mesh is None and jax.device_count() > 1:
         from galah_tpu.parallel.mesh import make_mesh
 
@@ -428,9 +452,12 @@ def _screen_pairs_single(
         inter = np.asarray(inter)[:count].astype(np.int64)
         gi = r0 + flat_idx // n_pad
         gj = flat_idx % n_pad
-        # exact host-side containment check
+        # exact host-side containment check. denom > 0 is belt and
+        # braces: the device stripe mask already requires inter > 0,
+        # and inter <= denom, so a denom == 0 pair cannot reach here —
+        # the guard just keeps this check self-contained.
         denom = np.minimum(counts64[gi], counts64[gj]).astype(np.float64)
-        keep = inter.astype(np.float64) >= c_floor * denom
+        keep = (denom > 0) & (inter.astype(np.float64) >= c_floor * denom)
         out.extend(zip(gi[keep].tolist(), gj[keep].tolist()))
     return out
 

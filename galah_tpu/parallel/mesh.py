@@ -412,7 +412,10 @@ def sharded_screen_pairs(
             (jnp.int32, jnp.int32), stripe_mask):
         inter = inter.astype(np.float64)
         denom = denom.astype(np.float64)
-        keep = inter >= c_floor * denom
+        # denom > 0 is belt and braces: the stripe mask already
+        # requires inter > 0 and inter <= denom, so a denom == 0 pair
+        # cannot reach here — the guard keeps this check self-contained
+        keep = (denom > 0) & (inter >= c_floor * denom)
         out.extend(zip(gi[keep].tolist(), gj[keep].tolist()))
     out.sort()
     return out

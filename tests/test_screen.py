@@ -131,3 +131,60 @@ def test_screen_pairs_pallas_interpret_matches_xla(monkeypatch):
         mat, counts, 0.6, row_tile=16, col_tile=32,
         mesh=make_mesh(1), use_pallas=False)
     assert via_pallas == via_xla
+
+
+def test_sparse_marker_screen_matches_dense():
+    """The CPU inverted-index marker screen returns exactly the tiled
+    XLA screen's pairs on family-structured marker sets (runs in a
+    single-device subprocess; the suite itself holds 8 devices)."""
+    import os
+    import subprocess
+    import sys
+
+    code = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from galah_tpu.ops.constants import SENTINEL
+from galah_tpu.ops.pairwise import screen_pairs
+
+assert jax.device_count() == 1
+rng = np.random.default_rng(51)
+n, m_width = 1100, 64
+n_fam = 90
+base = rng.integers(0, 1 << 62, size=(n_fam, m_width), dtype=np.uint64)
+mat = np.full((n, m_width), np.uint64(SENTINEL), dtype=np.uint64)
+counts = np.zeros(n, dtype=np.int64)
+for i in range(n):
+    fam = i % n_fam
+    cnt = int(rng.integers(20, m_width))
+    row = base[fam, :cnt].copy()
+    n_mut = int(rng.integers(0, 10))
+    idx = rng.choice(cnt, size=n_mut, replace=False)
+    row[idx] = rng.integers(0, 1 << 62, size=n_mut, dtype=np.uint64)
+    mat[i, :cnt] = np.sort(row)
+    counts[i] = cnt
+mat[5] = np.uint64(SENTINEL)   # zero-marker genome
+counts[5] = 0
+
+sparse = screen_pairs(mat, counts, 0.8 ** 15)
+os.environ["GALAH_TPU_DENSE_PAIRS"] = "1"
+dense = screen_pairs(mat, counts, 0.8 ** 15)
+assert sorted(sparse) == sorted(dense), (
+    len(sparse), len(dense),
+    set(map(tuple, sparse)) ^ set(map(tuple, dense)))
+assert len(dense) > 100
+assert not any(5 in p for p in dense)  # zero-marker genome never pairs
+print("OK")
+"""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=300,
+                          cwd=repo, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout
