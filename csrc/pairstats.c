@@ -178,6 +178,8 @@ typedef struct {
 static void *wm_worker(void *arg) {
     wm_job *w = (wm_job *)arg;
     const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
+    const uint64_t *ref = w->ref;
+    const int64_t H = w->H;
     for (int64_t r = w->tid; r < w->W; r += w->n_threads) {
         const uint64_t *row = w->wins + r * w->L;
         int32_t m = 0, t = 0;
@@ -185,15 +187,15 @@ static void *wm_worker(void *arg) {
             uint64_t h = row[i];
             if (h == SENT) continue;
             t++;
-            int64_t lo = 0, hi = w->H;
-            while (lo < hi) {
-                int64_t mid = (lo + hi) >> 1;
-                if (w->ref[mid] < h)
-                    lo = mid + 1;
-                else
-                    hi = mid;
+            /* branchless lower_bound: the compare compiles to cmov,
+             * halving the branchy version's misprediction stalls */
+            int64_t lo = 0, len = H;
+            while (len > 1) {
+                int64_t half = len >> 1;
+                lo += (ref[lo + half - 1] < h) ? half : 0;
+                len -= half;
             }
-            if (lo < w->H && w->ref[lo] == h) m++;
+            if (H > 0 && ref[lo] == h) m++;
         }
         w->matched[r] = m;
         w->total[r] = t;
@@ -208,6 +210,9 @@ void galah_window_match_counts(const uint64_t *wins, int64_t W,
     if (n_threads < 1) n_threads = 1;
     if (n_threads > 64) n_threads = 64;
     if ((int64_t)n_threads > W) n_threads = W > 0 ? (int)W : 1;
+    /* pthread spawn (~100 us each) swamps small membership tests —
+     * typical greedy-phase calls are a few dozen windows */
+    if (W * L < (int64_t)1 << 16) n_threads = 1;
     wm_job jobs[64];
     pthread_t tids[64];
     for (int t = 0; t < n_threads; t++)
