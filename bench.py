@@ -300,9 +300,10 @@ def main():
         "value": 0.0,
         "unit": "pairs/s",
         "vs_baseline": None,
-        "baseline": "xla-cpu-multicore tile_stats (no rustc in image; "
-                    "strongest available stand-in for the reference's "
-                    "compiled path)",
+        "baseline": "strongest of xla-cpu-multicore tile_stats and the "
+                    "compiled-C merged walk (csrc/pairstats.c) — no "
+                    "rustc in image; closest stand-ins for the "
+                    "reference's compiled pair loop",
         "stages": {},
         "errors": [],
     }
