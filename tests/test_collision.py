@@ -64,3 +64,35 @@ def test_compaction_threshold_is_exercised(monkeypatch):
     got = {(int(a), int(b)): int(c) for a, b, c in zip(pi, pj, counts)}
     assert got == _brute(mat, lens)
     assert _COMPACT_EVERY > 16  # the real threshold is untouched
+
+
+def test_threshold_sweep_sparse_equals_dense(monkeypatch):
+    """Sparse screened threshold_pairs_c equals dense across a sweep of
+    thresholds on mixed family/ragged/empty sketches."""
+    import pytest
+
+    cps = pytest.importorskip("galah_tpu.ops._cpairstats")
+
+    rng = np.random.default_rng(71)
+    n, k_sketch = 1050, 48
+    n_fam = 70
+    base = rng.integers(0, 1 << 62, size=(n_fam, k_sketch),
+                        dtype=np.uint64)
+    mat = np.empty((n, k_sketch), dtype=np.uint64)
+    for i in range(n):
+        row = base[i % n_fam].copy()
+        n_mut = int(rng.integers(0, 25))
+        idx = rng.choice(k_sketch, size=n_mut, replace=False)
+        row[idx] = rng.integers(0, 1 << 62, size=n_mut, dtype=np.uint64)
+        row.sort()
+        mat[i] = row
+    mat[3, 10:] = np.uint64(SENTINEL)   # ragged
+    mat[9] = np.uint64(SENTINEL)        # empty
+    mat.sort(axis=1)
+
+    for thr in (0.90, 0.95, 0.975, 0.99):
+        sparse = cps.threshold_pairs_c(mat, k_sketch, 21, thr)
+        monkeypatch.setenv("GALAH_TPU_DENSE_PAIRS", "1")
+        dense = cps.threshold_pairs_c(mat, k_sketch, 21, thr)
+        monkeypatch.delenv("GALAH_TPU_DENSE_PAIRS")
+        assert sparse == dense, thr
