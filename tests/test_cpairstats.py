@@ -237,3 +237,47 @@ def test_sparse_screen_big_runs(monkeypatch):
     dense = cps.threshold_pairs_c(mat, k_sketch, 21, 0.9)
     assert sparse == dense
     assert len(dense) >= 1100 * 1099 // 2
+
+
+def test_e2e_clusters_sparse_equals_dense(tmp_path):
+    """Above the screen cutoff, full cluster() compositions are
+    identical with and without the sparse screen (single-device CPU
+    subprocess; N > SPARSE_SCREEN_MIN_N)."""
+    import os
+    import subprocess
+    import sys
+
+    code = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, os.getcwd())
+import bench
+from galah_tpu.api import generate_galah_clusterer
+
+paths = bench._synth_families(n_genomes=1100, genome_len=20_000,
+                              n_families=275, mut=0.03, seed=43)
+values = {"ani": 95.0, "precluster_ani": 90.0,
+          "min_aligned_fraction": 15.0, "fragment_length": 3000,
+          "precluster_method": "finch", "cluster_method": "skani",
+          "threads": 1, "hash_algorithm": "tpufast",
+          "ani_subsample": 16}
+a = generate_galah_clusterer(paths, values).cluster()
+os.environ["GALAH_TPU_DENSE_PAIRS"] = "1"
+b = generate_galah_clusterer(paths, values).cluster()
+assert sorted(map(sorted, a)) == sorted(map(sorted, b))
+assert len(a) == 275, len(a)
+print("OK", len(a))
+"""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "GALAH_TPU_DENSE_PAIRS")}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=600,
+                          cwd=repo, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout
