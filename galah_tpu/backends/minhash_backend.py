@@ -66,26 +66,40 @@ class SketchStore:
         self._sketches[path] = s
         return s
 
-    def put_from_genome(self, path: str, genome) -> MinHashSketch:
-        """Sketch an already-ingested genome and cache it."""
-        s = sketch_genome_device(
+    def sketch_only(self, genome) -> MinHashSketch:
+        """Pure compute: sketch an ingested genome, no state mutation —
+        safe to run on process_stream worker threads; the consumer
+        inserts via `insert` (mirroring HLLPreclusterer/ProfileStore,
+        which mutate caches only on the consumer thread)."""
+        return sketch_genome_device(
             genome, sketch_size=self.sketch_size, k=self.k,
             seed=self.seed, algo=self.algo)
+
+    def insert(self, path: str, s: MinHashSketch) -> MinHashSketch:
+        """Record a computed sketch in memory and the disk cache."""
         self.cache.store(path, "minhash", self._params(),
                          {"hashes": s.hashes})
         self._sketches[path] = s
         return s
 
-    def put_from_genomes(self, items) -> "List[MinHashSketch]":
-        """Batch-sketch [(path, genome)] — grouped device dispatches
-        (ops/minhash.sketch_genomes_device_batch), bit-identical results."""
-        sketches = sketch_genomes_device_batch(
+    def put_from_genome(self, path: str, genome) -> MinHashSketch:
+        """Sketch an already-ingested genome and cache it."""
+        return self.insert(path, self.sketch_only(genome))
+
+    def sketch_batch_only(self, items) -> "List[MinHashSketch]":
+        """Pure compute twin of `sketch_only` for [(path, genome)]
+        buffers — grouped device dispatches
+        (ops/minhash.sketch_genomes_device_batch), bit-identical
+        results, no state mutation."""
+        return sketch_genomes_device_batch(
             [g for _, g in items], sketch_size=self.sketch_size,
             k=self.k, seed=self.seed, algo=self.algo)
+
+    def put_from_genomes(self, items) -> "List[MinHashSketch]":
+        """Batch-sketch [(path, genome)] and cache the results."""
+        sketches = self.sketch_batch_only(items)
         for (p, _), s in zip(items, sketches):
-            self.cache.store(p, "minhash", self._params(),
-                             {"hashes": s.hashes})
-            self._sketches[p] = s
+            self.insert(p, s)
         return sketches
 
     def get(self, path: str) -> MinHashSketch:
@@ -131,13 +145,15 @@ class MinHashPreclusterer(PreclusterBackend):
             by_path, miss_iter = probe_and_prefetch(
                 genome_paths, self.store.get_cached, read_genome,
                 depth=max(2, self.threads))
+            # worker threads only COMPUTE sketches; the consumer loop
+            # below is the single writer into the store and disk cache
             for p, s in process_stream(
                     miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
-                    self.store.put_from_genomes,
-                    self.store.put_from_genome,
+                    self.store.sketch_batch_only,
+                    lambda _path, g: self.store.sketch_only(g),
                     batched=hashing.device_transfer_bound(),
                     workers=self.threads):
-                by_path[p] = s
+                by_path[p] = self.store.insert(p, s)
             sketches = [by_path[p] for p in genome_paths]
             mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
         logger.info("Computing tiled all-pairs Mash ANI ..")

@@ -81,28 +81,13 @@ _fn_pl.argtypes = [
     ctypes.c_double, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
 ]
 
-# Crossover/env policy lives with the collision counter; re-exported
-# for existing importers.
-from galah_tpu.ops.collision import SPARSE_SCREEN_MIN_N  # noqa: E402
-
-
-def _candidate_pairs_sparse(mat: np.ndarray, lens: np.ndarray,
-                            j_thr: float, sketch_size: int):
-    """Conservative candidate pairs by hash-collision counting
-    (ops/collision.py). The exact per-pair |A ∩ B| upper-bounds the
-    merge walk's `common`, while its `total` is at least
-    t_min = min(sketch_size, max(|A|, |B|)) — so any pair with
-    count < j_thr * t_min provably fails the exact keep-check and is
-    skipped. Survivors get the exact walk; results are bit-identical
-    to the dense path.
-    """
-    from galah_tpu.ops.collision import collision_pair_counts
-
-    pi, pj, counts = collision_pair_counts(mat, lens)
-    t_min = np.minimum(
-        sketch_size, np.maximum(lens[pi], lens[pj])).astype(np.float64)
-    keep = counts.astype(np.float64) >= j_thr * t_min - 1e-9
-    return pi[keep], pj[keep]
+# Crossover/env policy and the conservative screen live with the
+# collision counter; re-exported for existing importers.
+from galah_tpu.ops.collision import (  # noqa: E402
+    SPARSE_SCREEN_MIN_N,
+    candidate_pairs_minhash as _candidate_pairs_sparse,
+    sparse_screen_min_n,
+)
 
 
 def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,
@@ -126,7 +111,7 @@ def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,
         threads = os.cpu_count() or 1
     j_thr = ani_to_jaccard(min_ani, kmer)
 
-    if (n >= SPARSE_SCREEN_MIN_N
+    if (n >= sparse_screen_min_n()
             and not os.environ.get("GALAH_TPU_DENSE_PAIRS")):
         pi, pj = _candidate_pairs_sparse(mat, lens, j_thr, sketch_size)
         out_ani = np.empty(pi.shape[0], dtype=np.float64)

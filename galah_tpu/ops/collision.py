@@ -28,6 +28,8 @@ O(K*m^2).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from galah_tpu.ops.constants import SENTINEL
@@ -36,8 +38,26 @@ _BIG_RUN = 64
 
 # Above this genome count the sparse collision screens replace the
 # dense O(N^2) passes (below it, dense is cheaper than sorting the
-# whole hash multiset). GALAH_TPU_DENSE_PAIRS=1 forces dense.
+# whole hash multiset). GALAH_TPU_DENSE_PAIRS=1 forces dense;
+# GALAH_TPU_SPARSE_MIN_N overrides the crossover (read per call, like
+# the DENSE_PAIRS gate, so late env changes take effect).
 SPARSE_SCREEN_MIN_N = 1024
+
+
+def sparse_screen_min_n() -> int:
+    """The sparse-screen crossover: GALAH_TPU_SPARSE_MIN_N when set to
+    a valid integer (malformed values are logged and ignored, never
+    fatal), else the module default (monkeypatchable in tests)."""
+    v = os.environ.get("GALAH_TPU_SPARSE_MIN_N")
+    if v:
+        try:
+            return int(v)
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ignoring malformed GALAH_TPU_SPARSE_MIN_N=%r", v)
+    return SPARSE_SCREEN_MIN_N
 
 # Emitted-key buffer compaction threshold: peak transient memory is
 # O(this + distinct pairs), never O(total emissions) — mid-size
@@ -125,3 +145,24 @@ def collision_pair_counts(mat: np.ndarray, lens: np.ndarray):
     if uniq.shape[0] == 0:
         return empty
     return uniq // n, uniq % n, counts
+
+
+def candidate_pairs_minhash(mat: np.ndarray, lens: np.ndarray,
+                            j_thr: float, sketch_size: int):
+    """Conservative MinHash candidate pairs by collision counting.
+
+    The exact per-pair |A ∩ B| upper-bounds the merged-bottom-k walk's
+    `common`, while that walk's `total` is at least
+    t_min = min(sketch_size, max(|A|, |B|)) — so any pair with
+    count < j_thr * t_min provably fails the exact keep-check
+    (common >= j_thr * total) and is skipped. Survivors must still get
+    the exact walk (C, XLA, or the batched device pass); results are
+    then bit-identical to the dense path. Shared by the CPU C kernel
+    (ops/_cpairstats.threshold_pairs_c) and the device sparse path
+    (ops/sparse_device.threshold_pairs_sparse).
+    """
+    pi, pj, counts = collision_pair_counts(mat, lens)
+    t_min = np.minimum(
+        sketch_size, np.maximum(lens[pi], lens[pj])).astype(np.float64)
+    keep = counts.astype(np.float64) >= j_thr * t_min - 1e-9
+    return pi[keep], pj[keep]

@@ -71,10 +71,14 @@ def hll_sketch_genome(
 ) -> np.ndarray:
     """(2^p,) uint8 HLL registers over the genome's canonical k-mers.
 
-    On a single-process CPU backend the compiled-C walker runs instead
+    On a single-device CPU backend the compiled-C walker runs instead
     (csrc/sketch.c::galah_hll_registers, bit-identical); an explicit
-    non-default chunk pins the JAX path."""
-    if (jax.default_backend() == "cpu" and k <= 32 and 1 <= p <= 24
+    non-default chunk pins the JAX path. The device_count() == 1
+    condition matches every other native-path gate (the op is
+    per-genome so results would be identical either way; one rule for
+    all gates keeps the policy auditable)."""
+    if (jax.default_backend() == "cpu" and jax.device_count() == 1
+            and k <= 32 and 1 <= p <= 24
             and chunk == hashing.DEFAULT_CHUNK):
         try:
             from galah_tpu.ops import _csketch

@@ -347,19 +347,21 @@ def screen_pairs(
     multi-device runtime the column-sharded SPMD twin
     (parallel/mesh.sharded_screen_pairs) is selected automatically.
     """
-    # Single-device CPU with no knobs pinned: the inverted-index
+    # No knobs pinned and above the sparse crossover: the inverted-index
     # collision counts ARE the containment numerators (marker sets are
     # distinct), so the host check below is exact with no second pass —
-    # O(NM log NM + colliding pairs) instead of O(N^2) tiles. The
-    # denom > 0 guard matches the tiled paths (see _screen_pairs_single).
-    from galah_tpu.ops.collision import SPARSE_SCREEN_MIN_N
+    # O(NM log NM + colliding pairs) instead of O(N^2) tiles, on ANY
+    # backend (the screen is pure host work; the device never needs to
+    # see the dense marker matrix at all). Tile/pallas knobs and an
+    # explicit mesh pin the dense tiled implementations for parity
+    # tests. The denom > 0 guard matches the tiled paths
+    # (see _screen_pairs_single).
+    from galah_tpu.ops.collision import sparse_screen_min_n
 
     if (mesh is None and use_pallas is None and row_tile is None
             and col_tile is None
-            and marker_mat.shape[0] >= SPARSE_SCREEN_MIN_N
-            and not os.environ.get("GALAH_TPU_DENSE_PAIRS")
-            and jax.default_backend() == "cpu"
-            and jax.device_count() == 1):
+            and marker_mat.shape[0] >= sparse_screen_min_n()
+            and not os.environ.get("GALAH_TPU_DENSE_PAIRS")):
         from galah_tpu.ops.collision import collision_pair_counts
 
         counts64 = np.asarray(counts, dtype=np.int64)
@@ -493,6 +495,12 @@ def threshold_pairs(
     On a multi-device runtime the column-sharded SPMD implementation
     (parallel/mesh.sharded_threshold_pairs) is selected automatically;
     pass `mesh` to choose one explicitly.
+
+    Above ops/collision.SPARSE_SCREEN_MIN_N genomes (no tile/pallas
+    knobs pinned) EVERY backend takes the screened sparse path instead
+    of dense tiles: host collision screen, then batched gathered pair
+    evaluation on device (ops/sparse_device.py) — bit-identical
+    results, O(NK log NK + survivors) instead of O(N^2).
     """
     # Single-device CPU backend with no knobs pinned: the compiled-C
     # merged-bottom-k walk (csrc/pairstats.c) measures ~13x the XLA-CPU
@@ -511,6 +519,28 @@ def threshold_pairs(
                     np.asarray(sketch_mat), eff, k, float(min_ani))
             except ImportError:
                 pass  # no C toolchain: fall through to the XLA path
+
+    # Device backends above the sparse crossover: screened evaluation
+    # (host collision screen + batched gathered pair stats on device)
+    # replaces the dense O(N^2) tiles — same two-phase shape as the CPU
+    # C path above, same bit-identical results. Tile/pallas knobs pin
+    # the dense implementations (parity tests rely on that); an
+    # explicit mesh is honored by sharding the candidate batches.
+    from galah_tpu.ops.collision import sparse_screen_min_n
+
+    if (use_pallas is None and row_tile is None and col_tile is None
+            and sketch_mat.shape[0] >= sparse_screen_min_n()
+            and not os.environ.get("GALAH_TPU_DENSE_PAIRS")):
+        from galah_tpu.ops.sparse_device import threshold_pairs_sparse
+
+        m = mesh
+        if m is None and jax.device_count() > 1:
+            from galah_tpu.parallel.mesh import make_mesh
+
+            m = make_mesh()
+        return threshold_pairs_sparse(
+            sketch_mat, k=k, min_ani=min_ani, sketch_size=sketch_size,
+            mesh=m if (m is not None and m.devices.size > 1) else None)
 
     # Auto-shard only when the caller left the knobs unset: explicit
     # use_pallas (True OR False) pins the single-device implementation,

@@ -1,0 +1,158 @@
+"""Sparse screened pairwise evaluation on the device path.
+
+The CPU backend eliminates the O(N^2) pair wall with the host
+inverted-index collision screen (ops/collision.py). This module ports
+that two-phase shape to the device backends (TPU and meshes, per the
+docs/DISTRIBUTED.md roadmap): the host produces the sparse candidate
+list by exact collision counting, and the device evaluates ONLY the
+survivors — batched (common, total) pair stats over gathered (i, j)
+sketch rows instead of dense (row x col) tiles. This is the screening
+idea of the reference's skani preclusterer (reference:
+src/skani.rs:54-70) applied to the MinHash pass on device.
+
+Exactness: the collision screen is conservative for merged-bottom-k
+Mash (ops/collision.candidate_pairs_minhash proves the bound), and the
+gathered-pair device pass computes the identical integer
+(common, total) as the dense tiles, so results are bit-identical to
+the dense path — pinned by tests/test_sparse_device.py.
+
+Cost model: collision counting is O(NK log NK + colliding pairs) on
+host; the device pass is O(S * K log K) for S surviving candidates.
+Above ops/collision.SPARSE_SCREEN_MIN_N genomes this replaces the
+O(N^2 * K log K / tile-throughput) dense wall whenever similarity is
+sparse (real dereplication inputs are: most genome pairs share no
+sketch hashes at all).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from galah_tpu.ops.constants import SENTINEL
+from galah_tpu.ops.pairwise import (
+    _pair_stats,
+    ani_to_jaccard,
+    stats_to_ani_f64,
+)
+
+# Candidate pairs evaluated per device dispatch. Large enough to
+# amortize dispatch latency (the gathered rows are B x K u64 reads
+# from HBM), small enough that the gather scratch stays tens of MB.
+PAIR_BATCH = 8192
+
+
+@functools.partial(jax.jit, static_argnames=("sketch_size",))
+def _batch_pair_stats(jmat: jax.Array, pi: jax.Array, pj: jax.Array,
+                      sketch_size: int) -> Tuple[jax.Array, jax.Array]:
+    """(common, total) int32 for each gathered (pi[b], pj[b]) row pair."""
+    rows = jnp.take(jmat, pi, axis=0)
+    cols = jnp.take(jmat, pj, axis=0)
+    return jax.vmap(
+        lambda a, b: _pair_stats(a, b, sketch_size))(rows, cols)
+
+
+@functools.lru_cache(maxsize=8)
+def _make_sharded_batch_stats(mesh: Mesh, sketch_size: int):
+    """SPMD twin: the candidate batch is sharded over the mesh axis,
+    the sketch matrix is replicated; each device evaluates its slice
+    of the pair list. No collective is needed — the outputs are
+    per-pair and come back shard-concatenated."""
+
+    def spmd(jmat, pi, pj):
+        return _batch_pair_stats(jmat, pi, pj, sketch_size)
+
+    fn = shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P(None, None), P("i"), P("i")),
+        out_specs=(P("i"), P("i")),
+    )
+    return jax.jit(fn)
+
+
+def pair_stats_for_pairs(
+    sketch_mat: np.ndarray,
+    pi: np.ndarray,
+    pj: np.ndarray,
+    sketch_size: int,
+    mesh: Optional[Mesh] = None,
+    batch: int = PAIR_BATCH,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact merged-bottom-k (common, total) for an explicit pair list.
+
+    One device dispatch per `batch` candidates (fixed shape, so the
+    trace compiles once); the final partial batch is padded with pair
+    (0, 0) and trimmed on host. With a multi-device `mesh` the batch is
+    sharded over the mesh axis.
+    """
+    n_pairs = int(pi.shape[0])
+    common = np.empty(n_pairs, dtype=np.int32)
+    total = np.empty(n_pairs, dtype=np.int32)
+    if n_pairs == 0:
+        return common, total
+
+    jmat = jnp.asarray(np.ascontiguousarray(sketch_mat, dtype=np.uint64))
+    n_dev = mesh.devices.size if mesh is not None else 1
+    b = -(-batch // n_dev) * n_dev
+    if mesh is not None and n_dev > 1:
+        fn = _make_sharded_batch_stats(mesh, sketch_size)
+    else:
+        fn = functools.partial(_batch_pair_stats,
+                               sketch_size=sketch_size)
+
+    pi32 = np.ascontiguousarray(pi, dtype=np.int32)
+    pj32 = np.ascontiguousarray(pj, dtype=np.int32)
+    for s in range(0, n_pairs, b):
+        e = min(s + b, n_pairs)
+        bi = np.zeros(b, dtype=np.int32)
+        bj = np.zeros(b, dtype=np.int32)
+        bi[: e - s] = pi32[s:e]
+        bj[: e - s] = pj32[s:e]
+        c, t = fn(jmat, jnp.asarray(bi), jnp.asarray(bj))
+        common[s:e] = np.asarray(c)[: e - s]
+        total[s:e] = np.asarray(t)[: e - s]
+    return common, total
+
+
+def threshold_pairs_sparse(
+    sketch_mat: np.ndarray,
+    k: int,
+    min_ani: float,
+    sketch_size: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+    batch: int = PAIR_BATCH,
+) -> dict:
+    """Sparse {(i, j): ani} for i<j pairs with ani >= min_ani — the
+    screened device pipeline: host collision screen, batched gathered
+    pair stats on device, exact f64 integer-Jaccard check on host.
+
+    Bit-identical to ops/pairwise.threshold_pairs' dense tiled path
+    (same integers, same f64 keep-check and ANI), selected by it above
+    ops/collision.SPARSE_SCREEN_MIN_N genomes on device backends.
+    """
+    from galah_tpu.ops.collision import candidate_pairs_minhash
+
+    mat = np.ascontiguousarray(sketch_mat, dtype=np.uint64)
+    n = mat.shape[0]
+    if sketch_size is None:
+        sketch_size = mat.shape[1]
+    lens = (mat != np.uint64(SENTINEL)).sum(axis=1).astype(np.int64)
+    j_thr = ani_to_jaccard(min_ani, k)
+    pi, pj = candidate_pairs_minhash(mat, lens, j_thr, sketch_size)
+    del n  # candidates are already in-bounds i < j < n
+    if pi.shape[0] == 0:
+        return {}
+    common, total = pair_stats_for_pairs(
+        mat, pi, pj, sketch_size, mesh=mesh, batch=batch)
+    common = common.astype(np.int64)
+    total = total.astype(np.int64)
+    keep = common.astype(np.float64) >= j_thr * total
+    ani = stats_to_ani_f64(common[keep], total[keep], k)
+    return {(int(a), int(b)): float(v)
+            for a, b, v in zip(pi[keep], pj[keep], ani)}

@@ -1,0 +1,149 @@
+"""Device-path sparse screen: bit-identity with the dense tiled path,
+sharded batch evaluation, and gate selection on device backends."""
+
+import numpy as np
+import pytest
+
+import galah_tpu.ops.collision as collision
+import galah_tpu.ops.sparse_device as sparse_device
+from galah_tpu.ops.constants import SENTINEL
+from galah_tpu.ops.pairwise import (
+    _threshold_pairs_single,
+    screen_pairs,
+    threshold_pairs,
+)
+from galah_tpu.ops.sparse_device import (
+    pair_stats_for_pairs,
+    threshold_pairs_sparse,
+)
+
+
+def _family_sketches(n=1100, width=48, n_fam=80, seed=91,
+                     mutations=25):
+    """Family-structured sorted sketch matrix with ragged/empty rows."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 1 << 62, size=(n_fam, width), dtype=np.uint64)
+    mat = np.empty((n, width), dtype=np.uint64)
+    for i in range(n):
+        row = base[i % n_fam].copy()
+        n_mut = int(rng.integers(0, mutations))
+        idx = rng.choice(width, size=n_mut, replace=False)
+        row[idx] = rng.integers(0, 1 << 62, size=n_mut, dtype=np.uint64)
+        row.sort()
+        mat[i] = row
+    mat[3, 10:] = np.uint64(SENTINEL)   # ragged
+    mat[9] = np.uint64(SENTINEL)        # empty
+    mat.sort(axis=1)
+    return mat
+
+
+def test_sparse_equals_dense_threshold_sweep():
+    mat = _family_sketches()
+    for thr in (0.90, 0.95, 0.99):
+        dense = _threshold_pairs_single(
+            mat, k=21, min_ani=thr, sketch_size=mat.shape[1],
+            row_tile=64, col_tile=128, use_pallas=False, cap_per_row=64)
+        sparse = threshold_pairs_sparse(mat, k=21, min_ani=thr)
+        assert sparse == dense, thr
+
+
+def test_sparse_batched_partial_batches():
+    """A batch size that does not divide the candidate count exercises
+    the pad-and-trim path; results unchanged."""
+    mat = _family_sketches(n=300, n_fam=30, seed=17)
+    full = threshold_pairs_sparse(mat, k=21, min_ani=0.95)
+    small = threshold_pairs_sparse(mat, k=21, min_ani=0.95, batch=37)
+    assert small == full
+    assert len(full) > 0
+
+
+def test_pair_stats_for_pairs_sharded_equals_single():
+    from galah_tpu.parallel.mesh import make_mesh
+
+    mat = _family_sketches(n=200, n_fam=20, seed=23)
+    rng = np.random.default_rng(5)
+    pi = rng.integers(0, 199, size=501).astype(np.int64)
+    pj = np.minimum(pi + 1 + rng.integers(0, 50, size=501), 199)
+    c1, t1 = pair_stats_for_pairs(mat, pi, pj, mat.shape[1])
+    mesh = make_mesh()
+    c2, t2 = pair_stats_for_pairs(mat, pi, pj, mat.shape[1], mesh=mesh)
+    np.testing.assert_array_equal(c1, c2)
+    np.testing.assert_array_equal(t1, t2)
+
+
+def test_no_collisions_returns_empty():
+    rng = np.random.default_rng(7)
+    n = 64
+    mat = np.sort(
+        rng.choice(1 << 62, size=(n, 32), replace=False)
+        .astype(np.uint64), axis=1)
+    assert threshold_pairs_sparse(mat, k=21, min_ani=0.95) == {}
+
+
+def test_public_gate_selects_sparse_path(monkeypatch):
+    """Above the crossover with no knobs pinned, threshold_pairs routes
+    to the sparse device pipeline (with the auto mesh on a multi-device
+    runtime) and returns the dense-identical result."""
+    mat = _family_sketches(n=160, n_fam=16, seed=29)
+    monkeypatch.setattr(collision, "SPARSE_SCREEN_MIN_N", 100)
+
+    calls = {}
+    real = sparse_device.threshold_pairs_sparse
+
+    def spy(*args, **kwargs):
+        calls["mesh"] = kwargs.get("mesh")
+        calls["hit"] = True
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(sparse_device, "threshold_pairs_sparse", spy)
+    got = threshold_pairs(mat, k=21, min_ani=0.95)
+    assert calls.get("hit"), "sparse path must be selected"
+    import jax
+
+    if jax.device_count() > 1:
+        assert calls["mesh"] is not None and calls["mesh"].devices.size > 1
+
+    dense = _threshold_pairs_single(
+        mat, k=21, min_ani=0.95, sketch_size=mat.shape[1],
+        row_tile=64, col_tile=128, use_pallas=False, cap_per_row=64)
+    assert got == dense
+
+
+def test_public_gate_dense_env_pins_dense(monkeypatch):
+    mat = _family_sketches(n=160, n_fam=16, seed=29)
+    monkeypatch.setattr(collision, "SPARSE_SCREEN_MIN_N", 100)
+    monkeypatch.setenv("GALAH_TPU_DENSE_PAIRS", "1")
+
+    def boom(*a, **k):  # the sparse path must NOT be taken
+        raise AssertionError("sparse path selected despite env pin")
+
+    monkeypatch.setattr(sparse_device, "threshold_pairs_sparse", boom)
+    got = threshold_pairs(mat, k=21, min_ani=0.95)
+    assert len(got) > 0
+
+
+def test_screen_pairs_sparse_on_any_backend(monkeypatch):
+    """The marker screen's collision path is exact and now engages on
+    every backend (the conftest runtime is an 8-device CPU mesh)."""
+    rng = np.random.default_rng(41)
+    n, m = 150, 40
+    n_fam = 15
+    base = rng.integers(0, 1 << 62, size=(n_fam, m), dtype=np.uint64)
+    mat = np.empty((n, m), dtype=np.uint64)
+    for i in range(n):
+        row = base[i % n_fam].copy()
+        n_mut = int(rng.integers(0, 12))
+        idx = rng.choice(m, size=n_mut, replace=False)
+        row[idx] = rng.integers(0, 1 << 62, size=n_mut, dtype=np.uint64)
+        row.sort()
+        mat[i] = row
+    counts = np.full(n, m, dtype=np.int64)
+
+    monkeypatch.setattr(collision, "SPARSE_SCREEN_MIN_N", 100)
+    sparse = screen_pairs(mat, counts, 0.8)
+
+    from galah_tpu.ops.pairwise import _screen_pairs_single
+
+    dense = _screen_pairs_single(mat, counts, 0.8, 64, 128, 256, False)
+    assert sorted(sparse) == sorted(dense)
+    assert len(sparse) > 0
