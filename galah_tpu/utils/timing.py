@@ -29,7 +29,20 @@ class StageTimer:
         self._acc: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
         self._order: List[str] = []
+        self._counters: Dict[str, int] = {}
+        self._counter_order: List[str] = []
         self._t0 = time.perf_counter()
+
+    def counter(self, name: str, delta: int) -> None:
+        """Accumulate a named integer (work counts, waste counts, ...);
+        counters appear at the end of the stage report."""
+        if name not in self._counters:
+            self._counters[name] = 0
+            self._counter_order.append(name)
+        self._counters[name] += int(delta)
+
+    def counters(self) -> Dict[str, int]:
+        return dict(self._counters)
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
@@ -58,6 +71,9 @@ class StageTimer:
             suffix = f" x{count}" if count > 1 else ""
             lines.append(f"{name}: {acc:.2f}s ({share:.0f}%){suffix}")
         text = "; ".join(lines) + f"; total {total:.2f}s"
+        if self._counters:
+            text += "; " + "; ".join(
+                f"{n}={self._counters[n]}" for n in self._counter_order)
         log.info("Stage timings: %s", text)
         return text
 
@@ -70,6 +86,10 @@ GLOBAL = StageTimer()
 
 def stage(name: str):
     return GLOBAL.stage(name)
+
+
+def counter(name: str, delta: int) -> None:
+    GLOBAL.counter(name, delta)
 
 
 def reset() -> None:

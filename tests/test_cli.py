@@ -211,6 +211,37 @@ def test_cluster_validate_roundtrip(tmp_path):
     assert rc == 0
 
 
+def test_cluster_validate_unit_semantics(monkeypatch, tmp_path):
+    """--ani 99 and --ani 0.99 both mean fraction 0.99 (PARITY.md).
+
+    The reference parses the flag to a fraction and then multiplies by
+    100 because its fastANI wrapper works in percent units (reference:
+    src/cluster_validation.rs:13) — the two spellings coincide there
+    too, so the CLI contract is identical; this framework simply stays
+    in fractions end to end. This test pins that recorded decision.
+    """
+    import galah_tpu.validate as validate_mod
+
+    clusters = tmp_path / "clusters.tsv"
+    g = f"{DATA}/set1/500kb.fna"
+    clusters.write_text(f"{g}\t{g}\n")
+
+    seen = []
+
+    def spy(cluster_file, clusterer):
+        seen.append(clusterer.ani_threshold)
+        return 0
+
+    monkeypatch.setattr(validate_mod, "validate_clusters", spy)
+    for spelling in ("99", "0.99"):
+        rc = _run([
+            "cluster-validate", "--cluster-file", str(clusters),
+            "--ani", spelling, "--min-aligned-fraction", "20",
+        ])
+        assert rc == 0
+    assert seen == [0.99, 0.99]
+
+
 def test_no_genome_input_errors():
     rc = _run(["cluster", "--output-representative-list", "/dev/null"])
     assert rc == 1

@@ -206,3 +206,59 @@ def test_windowed_rep_scan_bounds_dispatches():
     # (only genome 1: rep 0 emerges in window 0 before it). Allow a
     # little slack but pin "far fewer than n".
     assert len(cl.calls) <= 8, len(cl.calls)
+
+
+def test_rep_scan_window_invariance_and_waste_counters():
+    """Clusters are identical for any rep_scan_window (the speculative
+    batches only pre-fill the ANI cache; decisions read the same
+    values), and the waste counters account computed vs consulted."""
+    from galah_tpu.utils import timing
+
+    n = 60
+    rng_pairs = {(i, j): 0.96 for i in range(n) for j in range(i + 1, n)}
+    table = {}
+    for i in range(n):
+        for j in range(i + 1, n):
+            fam_i, fam_j = i % 3, j % 3
+            table[(f"g{i}.fna", f"g{j}.fna")] = (
+                0.99 if fam_i == fam_j else 0.80)
+    pre = StubPreclusterer(rng_pairs, name="pre")
+
+    results = []
+    for window in (None, 1, 7):
+        cl = StubClusterer(table, threshold=0.95, name="exact")
+        before = timing.GLOBAL.counters()
+        clusters = cluster(g(n), pre, cl, dense_precluster_cap=0,
+                           rep_scan_window=window)
+        after = timing.GLOBAL.counters()
+        results.append(sorted(sorted(c) for c in clusters))
+        computed = (after.get("exact-ani-computed", 0)
+                    - before.get("exact-ani-computed", 0))
+        wasted = (after.get("exact-ani-wasted", 0)
+                  - before.get("exact-ani-wasted", 0))
+        assert computed > 0
+        assert 0 <= wasted <= computed
+    assert results[0] == results[1] == results[2]
+    # 3 families of 20
+    assert [len(c) for c in results[0]] == [20, 20, 20]
+
+
+def test_warm_pass_waste_is_counted():
+    """The dense-warm path's upfront ANIs enter the computed counter,
+    so unconsulted warm pairs surface as waste."""
+    from galah_tpu.utils import timing
+
+    n = 8
+    pre_pairs = {(i, j): 0.96 for i in range(n) for j in range(i + 1, n)}
+    table = {(f"g{i}.fna", f"g{j}.fna"): 0.99
+             for i in range(n) for j in range(i + 1, n)}
+    pre = StubPreclusterer(pre_pairs, name="pre")
+    cl = StubClusterer(table, threshold=0.95, name="exact")
+    before = timing.GLOBAL.counters()
+    clusters = cluster(g(n), pre, cl)  # default dense cap: warm path
+    after = timing.GLOBAL.counters()
+    assert len(clusters) == 1
+    computed = (after.get("exact-ani-computed", 0)
+                - before.get("exact-ani-computed", 0))
+    # every hit pair was warmed upfront: n*(n-1)/2
+    assert computed == n * (n - 1) // 2
