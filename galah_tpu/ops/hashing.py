@@ -3,9 +3,12 @@
 Device-side twin of ops/murmur3_np.py / ops/minhash_np.py, verified
 bit-exact against them in tests/test_minhash.py. All shapes are static; a
 genome is processed as fixed-size chunks so XLA compiles once per chunk
-size. uint64 arithmetic wraps (XLA emulates 64-bit integers with u32 pairs
-on TPU; if profiling shows hashing hot, the planned optimization is a
-Pallas u32-pair kernel).
+size. uint64 arithmetic wraps (XLA emulates 64-bit integers with u32
+pairs on TPU). The explicit u32-pair Mosaic implementation of the
+murmur state machine exists in ops/pallas_sketch.py (16-bit-limb
+constant multiplies, bit-identical): opt in with GALAH_TPU_PALLAS_HASH=1
+(read at first trace; k=21 murmur3 only), benched against this XLA
+path by scripts/bench_sketch_variants.py on hardware.
 
 Hash semantics mirror the reference's finch backend contract
 (reference: src/finch.rs:33-47): canonical (lexicographic min of forward /
@@ -294,7 +297,22 @@ def _hash_core(
             for j in range(k)
         ]
         if k == 21:
-            hashes = _murmur3_k21_1d(cb, seed)
+            # Opt-in Mosaic hash state machine (read at FIRST TRACE of
+            # the enclosing jit — set before first use, or
+            # jax.clear_caches()); interpret mode keeps the opt-in
+            # exercisable on CPU backends.
+            if os.environ.get("GALAH_TPU_PALLAS_HASH") == "1":
+                from galah_tpu.ops.pallas_sketch import (
+                    assemble_k21_words,
+                    murmur3_k21_pallas,
+                )
+
+                kw1, kw2, kwt = assemble_k21_words(cb)
+                hashes = murmur3_k21_pallas(
+                    kw1, kw2, kwt, seed=seed,
+                    interpret=jax.default_backend() != "tpu")
+            else:
+                hashes = _murmur3_k21_1d(cb, seed)
         else:
             ascii_kmers = jnp.stack(cb, axis=1).astype(jnp.uint8)
             hashes = murmur3_x64_128_h1(ascii_kmers, seed=seed)

@@ -1,0 +1,248 @@
+"""Pallas TPU kernel: MurmurHash3 x64_128 (h1) over u32 plane pairs.
+
+The reference's finch contract hashes every canonical k-mer with
+murmur3 x64_128 (reference: src/finch.rs:33-47) — 11 u64
+multiply-by-constant operations per k-mer. The TPU VPU has no 64-bit
+integer unit; XLA emulates every u64 op over u32 pairs generically,
+and the multiplies dominate device sketching. This kernel is the
+promised explicit u32-pair implementation (ops/hashing.py's module
+docstring): the murmur state machine runs on (hi, lo) uint32 planes
+with each constant multiply decomposed into 16-bit limb products
+(every 16x16 product fits u32 exactly; per-column limb accumulators
+stay below 2^19, so one carry-propagation pass at the end suffices) —
+the minimal-width schoolbook XLA's generic emulation cannot assume.
+
+Scope: the k=21 MinHash production path. Input is the three assembled
+key words (k1: bytes 0-7, k2: bytes 8-15, k1 tail: bytes 16-20) that
+ops/hashing's XLA preamble already builds with cheap shift/or chains;
+the kernel fuses the whole hash state machine — one block-elementwise
+pass, no u64 intermediates in HBM. Bit-identical to
+ops/hashing._murmur3_k21_1d (tests/test_pallas_sketch.py, interpret
+mode on CPU; tests/test_tpu_hw.py on hardware).
+
+Selection: opt-in via hash_algo="murmur3" + GALAH_TPU_PALLAS_HASH=1
+or the explicit entry point; scripts/bench_sketch_variants.py captures
+kernel-vs-XLA throughput whenever a chip is reachable. The XLA path
+stays the default until on-chip numbers justify the switch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+BLOCK_SUB = 512  # sublanes per grid program (block = BLOCK_SUB x 128)
+
+_C1 = 0x87C37B91114253D5
+_C2 = 0x4CF5AD432745937F
+_F1 = 0xFF51AFD7ED558CCD
+_F2 = 0xC4CEB9FE1A85EC53
+
+
+def _limbs16(c: int):
+    return [(c >> (16 * j)) & 0xFFFF for j in range(4)]
+
+
+def _mulc64(hi: jax.Array, lo: jax.Array, c: int):
+    """(hi, lo) u32 planes * 64-bit constant c, mod 2^64.
+
+    Schoolbook over 16-bit limbs: products x_i * c_j with i + j <= 3,
+    lo16 into column i+j, hi16 into column i+j+1; each column
+    accumulates at most 8 terms < 2^16 (< 2^19 total), then one carry
+    sweep rebuilds the planes. Zero limbs of c skip their products at
+    trace time.
+    """
+    x = [lo & 0xFFFF, lo >> jnp.uint32(16), hi & 0xFFFF, hi >> jnp.uint32(16)]
+    cl = _limbs16(c)
+    acc = [None, None, None, None]
+
+    def _addto(k, v):
+        acc[k] = v if acc[k] is None else acc[k] + v
+
+    for i in range(4):
+        for j in range(4 - i):
+            if cl[j] == 0:
+                continue
+            p = x[i] * jnp.uint32(cl[j])
+            k = i + j
+            _addto(k, p & 0xFFFF)
+            if k + 1 < 4:
+                _addto(k + 1, p >> jnp.uint32(16))
+    zero = jnp.zeros_like(lo)
+    acc = [a if a is not None else zero for a in acc]
+
+    l0 = acc[0] & 0xFFFF
+    carry = acc[0] >> jnp.uint32(16)
+    a1 = acc[1] + carry
+    l1 = a1 & 0xFFFF
+    carry = a1 >> jnp.uint32(16)
+    a2 = acc[2] + carry
+    l2 = a2 & 0xFFFF
+    carry = a2 >> jnp.uint32(16)
+    l3 = (acc[3] + carry) & 0xFFFF
+    return (l2 | (l3 << jnp.uint32(16))), (l0 | (l1 << jnp.uint32(16)))
+
+
+def _add64(hi, lo, bhi, blo):
+    lo2 = lo + blo
+    carry = (lo2 < blo).astype(jnp.uint32)
+    return hi + bhi + carry, lo2
+
+
+def _addc64(hi, lo, c: int):
+    return _add64(hi, lo, jnp.uint32((c >> 32) & 0xFFFFFFFF),
+                  jnp.uint32(c & 0xFFFFFFFF))
+
+
+def _xorc64(hi, lo, c: int):
+    return (hi ^ jnp.uint32((c >> 32) & 0xFFFFFFFF),
+            lo ^ jnp.uint32(c & 0xFFFFFFFF))
+
+
+def _rotl64(hi, lo, r: int):
+    if r == 32:
+        return lo, hi
+    if r < 32:
+        return ((hi << jnp.uint32(r)) | (lo >> jnp.uint32(32 - r)),
+                (lo << jnp.uint32(r)) | (hi >> jnp.uint32(32 - r)))
+    s = r - 32
+    return ((lo << jnp.uint32(s)) | (hi >> jnp.uint32(32 - s)),
+            (hi << jnp.uint32(s)) | (lo >> jnp.uint32(32 - s)))
+
+
+def _shr64_xor(hi, lo, r: int):
+    """(hi, lo) ^= (hi, lo) >> r, for the fmix xorshifts (r = 33)."""
+    if r < 32:
+        nhi = hi >> jnp.uint32(r)
+        nlo = (lo >> jnp.uint32(r)) | (hi << jnp.uint32(32 - r))
+    else:
+        nhi = jnp.zeros_like(hi)
+        nlo = hi >> jnp.uint32(r - 32)
+    return hi ^ nhi, lo ^ nlo
+
+
+def _fmix64(hi, lo):
+    hi, lo = _shr64_xor(hi, lo, 33)
+    hi, lo = _mulc64(hi, lo, _F1)
+    hi, lo = _shr64_xor(hi, lo, 33)
+    hi, lo = _mulc64(hi, lo, _F2)
+    return _shr64_xor(hi, lo, 33)
+
+
+def _make_kernel(seed: int):
+    seed_hi = (seed >> 32) & 0xFFFFFFFF
+    seed_lo = seed & 0xFFFFFFFF
+
+    def kernel(k1h, k1l, k2h, k2l, th, tl, outh, outl):
+        h1h = jnp.full_like(k1h[:], jnp.uint32(seed_hi))
+        h1l = jnp.full_like(k1l[:], jnp.uint32(seed_lo))
+        h2h, h2l = h1h, h1l
+
+        # body block: k1 = rotl(k1*C1, 31)*C2 folded into h1, then k2
+        a, b = _mulc64(k1h[:], k1l[:], _C1)
+        a, b = _rotl64(a, b, 31)
+        a, b = _mulc64(a, b, _C2)
+        h1h, h1l = h1h ^ a, h1l ^ b
+        h1h, h1l = _rotl64(h1h, h1l, 27)
+        h1h, h1l = _add64(h1h, h1l, h2h, h2l)
+        h1h, h1l = _mulc64(h1h, h1l, 5)
+        h1h, h1l = _addc64(h1h, h1l, 0x52DCE729)
+
+        a, b = _mulc64(k2h[:], k2l[:], _C2)
+        a, b = _rotl64(a, b, 33)
+        a, b = _mulc64(a, b, _C1)
+        h2h, h2l = h2h ^ a, h2l ^ b
+        h2h, h2l = _rotl64(h2h, h2l, 31)
+        h2h, h2l = _add64(h2h, h2l, h1h, h1l)
+        h2h, h2l = _mulc64(h2h, h2l, 5)
+        h2h, h2l = _addc64(h2h, h2l, 0x38495AB5)
+
+        # 5-byte tail folds into h1 only; the contract uses only the
+        # low 5 bytes of the tail word, so mask byte 4's plane here
+        # rather than trusting every caller to pre-zero bytes 5-7
+        a, b = _mulc64(th[:] & 0xFF, tl[:], _C1)
+        a, b = _rotl64(a, b, 31)
+        a, b = _mulc64(a, b, _C2)
+        h1h, h1l = h1h ^ a, h1l ^ b
+
+        # finalization, length = 21
+        h1h, h1l = _xorc64(h1h, h1l, 21)
+        h2h, h2l = _xorc64(h2h, h2l, 21)
+        h1h, h1l = _add64(h1h, h1l, h2h, h2l)
+        h2h, h2l = _add64(h2h, h2l, h1h, h1l)
+        h1h, h1l = _fmix64(h1h, h1l)
+        h2h, h2l = _fmix64(h2h, h2l)
+        h1h, h1l = _add64(h1h, h1l, h2h, h2l)
+        outh[:] = h1h
+        outl[:] = h1l
+
+    return kernel
+
+
+def _zi(i):
+    return i * 0
+
+
+@functools.partial(jax.jit, static_argnames=("seed", "interpret"))
+def murmur3_k21_pallas(
+    k1: jax.Array,    # uint64 (n,): bytes 0-7 of the canonical k-mer
+    k2: jax.Array,    # uint64 (n,): bytes 8-15
+    k1t: jax.Array,   # uint64 (n,): bytes 16-20 (low 5 bytes used)
+    seed: int = 0,
+    interpret: bool = False,
+) -> jax.Array:
+    """h1 of murmur3 x64_128 over 21-byte keys given as assembled
+    little-endian words — bit-identical to ops/hashing._murmur3_k21_1d.
+    """
+    n = k1.shape[0]
+    quantum = BLOCK_SUB * LANES
+    n_pad = max(quantum, -(-n // quantum) * quantum)
+
+    def planes(x):
+        xp = jnp.zeros((n_pad,), jnp.uint64).at[:n].set(x)
+        return ((xp >> jnp.uint64(32)).astype(jnp.uint32)
+                .reshape(n_pad // LANES, LANES),
+                xp.astype(jnp.uint32).reshape(n_pad // LANES, LANES))
+
+    k1h, k1l = planes(k1)
+    k2h, k2l = planes(k2)
+    th, tl = planes(k1t)
+
+    rows = n_pad // LANES
+    grid = rows // BLOCK_SUB
+    spec = pl.BlockSpec((BLOCK_SUB, LANES), lambda i: (i, _zi(i)),
+                        memory_space=pltpu.VMEM)
+    outh, outl = pl.pallas_call(
+        _make_kernel(seed),
+        grid=(grid,),
+        in_specs=[spec] * 6,
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+                   jax.ShapeDtypeStruct((rows, LANES), jnp.uint32)],
+        interpret=interpret,
+    )(k1h, k1l, k2h, k2l, th, tl)
+    out = (outh.reshape(-1).astype(jnp.uint64) << jnp.uint64(32)) \
+        | outl.reshape(-1).astype(jnp.uint64)
+    return out[:n]
+
+
+def assemble_k21_words(cb) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Little-endian key words (k1, k2, tail) from 21 per-byte u64
+    vectors — the same shift/or assembly _murmur3_k21_1d runs inline;
+    shared so the kernel consumes identical inputs."""
+    k1 = cb[0]
+    for b in range(1, 8):
+        k1 = k1 | (cb[b] << jnp.uint64(8 * b))
+    k2 = cb[8]
+    for b in range(1, 8):
+        k2 = k2 | (cb[8 + b] << jnp.uint64(8 * b))
+    t = cb[16]
+    for b in range(1, 5):
+        t = t | (cb[16 + b] << jnp.uint64(8 * b))
+    return k1, k2, t

@@ -78,6 +78,39 @@ def main():
           f"{C / t_hf / 1e6:.1f} Mpos/s (sort overhead "
           f"{(t_hf - t_h) / t_hf * 100:.0f}%)", flush=True)
 
+    # Mosaic murmur state machine (ops/pallas_sketch.py) vs the XLA
+    # u64-emulated hash core, device-resident key words: answers
+    # whether the 16-bit-limb kernel beats XLA's generic emulation
+    # on-chip (parity is separately pinned in test_tpu_hw.py).
+    from galah_tpu.ops.hashing import _murmur3_k21_1d
+    from galah_tpu.ops.pallas_sketch import murmur3_k21_pallas
+
+    n = C
+    kw = [jax.device_put(jnp.asarray(
+        rng.integers(0, 1 << 64, size=n, dtype=np.uint64)))
+        for _ in range(3)]
+
+    @jax.jit
+    def xla_hash(k1, k2, t):
+        # the same state machine on the same pre-assembled words, via
+        # XLA's u64 emulation (byte re-extraction feeds the shared
+        # assembly in _murmur3_k21_1d; shift/and cost is negligible
+        # next to the 11 u64 multiplies being measured)
+        cb = [(k1 >> jnp.uint64(8 * b)) & jnp.uint64(0xFF)
+              for b in range(8)]
+        cb += [(k2 >> jnp.uint64(8 * b)) & jnp.uint64(0xFF)
+               for b in range(8)]
+        cb += [(t >> jnp.uint64(8 * b)) & jnp.uint64(0xFF)
+               for b in range(5)]
+        return _murmur3_k21_1d(cb, 0)
+
+    t_xla = _timeit(lambda: np.asarray(xla_hash(*kw)[:4]))
+    t_mosaic = _timeit(lambda: np.asarray(
+        murmur3_k21_pallas(*kw, seed=0)[:4]))
+    print(f"murmur core: XLA {n / t_xla / 1e6:.1f} Mkmer/s, Mosaic "
+          f"{n / t_mosaic / 1e6:.1f} Mkmer/s "
+          f"({t_xla / t_mosaic:.2f}x)", flush=True)
+
     # per-genome vs batch on real MAGs (shared bench corpus)
     from bench import bench_genomes
     from galah_tpu.ops.minhash import (

@@ -61,6 +61,20 @@ ps_p, z_p = hll_union_stats_tile(pr, pr, chunk=1024)
 ps_x, z_x = hll._xla_union_stats(pr, pr)
 assert np.allclose(np.asarray(ps_p), np.asarray(ps_x), rtol=1e-5)
 assert np.array_equal(np.asarray(z_p), np.asarray(z_x))
+
+# Mosaic murmur3 state machine (ops/pallas_sketch.py) lowers and
+# matches the XLA u64-emulated hash core bit-for-bit
+from galah_tpu.ops.hashing import _murmur3_k21_1d
+from galah_tpu.ops.pallas_sketch import murmur3_k21_pallas
+n = 70000  # > one 512x128 block, forces a multi-program grid
+kw = [jnp.asarray(rng.integers(0, 1 << 64, size=n, dtype=np.uint64))
+      for _ in range(3)]
+cb = [(kw[0] >> jnp.uint64(8 * b)) & jnp.uint64(0xFF) for b in range(8)]
+cb += [(kw[1] >> jnp.uint64(8 * b)) & jnp.uint64(0xFF) for b in range(8)]
+cb += [(kw[2] >> jnp.uint64(8 * b)) & jnp.uint64(0xFF) for b in range(5)]
+want = np.asarray(_murmur3_k21_1d(cb, 0))
+got = np.asarray(murmur3_k21_pallas(kw[0], kw[1], kw[2], seed=0))
+assert np.array_equal(got, want), "mosaic murmur mismatch"
 print("TPUOK")
 """
 
