@@ -130,32 +130,79 @@ class MinHashPreclusterer(PreclusterBackend):
     def method_name(self) -> str:
         return "finch"
 
+    def _sketch_paths(self, paths: Sequence[str]) -> dict:
+        """path -> sketch for (deduped) paths: cache probe + prefetch +
+        batched device sketching. Worker threads only COMPUTE sketches;
+        the consumer loop is the single writer into the store and disk
+        cache."""
+        from galah_tpu.io.prefetch import (
+            probe_and_prefetch,
+            process_stream,
+        )
+
+        by_path, miss_iter = probe_and_prefetch(
+            paths, self.store.get_cached, read_genome,
+            depth=max(2, self.threads))
+        for p, s in process_stream(
+                miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
+                self.store.sketch_batch_only,
+                lambda _path, g: self.store.sketch_only(g),
+                batched=hashing.device_transfer_bound(),
+                workers=self.threads):
+            by_path[p] = self.store.insert(p, s)
+        return by_path
+
+    def _sketch_matrix_multihost(self, genome_paths: Sequence[str],
+                                 n_proc: int):
+        """Per-host ingestion: each host reads + sketches only its
+        strided shard of the unique genome list (FASTA IO and hashing
+        scale linearly with hosts), then the padded sketch rows are
+        exchanged with one process_allgather and reassembled into the
+        full matrix on every host — identical on all hosts, so the
+        downstream screen/engine decisions are too. The full matrix is
+        K*8 bytes per genome (~8 KB at K=1000): 50k genomes is ~400 MB
+        per host, far below the per-genome FASTA cost being split."""
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        from galah_tpu.ops.constants import SENTINEL
+        from galah_tpu.parallel import distributed
+
+        unique = list(dict.fromkeys(genome_paths))
+        mine = distributed.host_shard(unique)
+        by_path = self._sketch_paths(mine)
+        local = sketch_matrix([by_path[p] for p in mine],
+                              sketch_size=self.sketch_size) \
+            if mine else np.zeros((0, self.sketch_size), np.uint64)
+
+        per = -(-len(unique) // n_proc)
+        padded = np.full((per, self.sketch_size), np.uint64(SENTINEL),
+                         dtype=np.uint64)
+        padded[: local.shape[0]] = local
+        gathered = np.asarray(
+            multihost_utils.process_allgather(padded, tiled=False))
+        mat = np.empty((len(unique), self.sketch_size), dtype=np.uint64)
+        for p in range(n_proc):
+            idxs = np.arange(p, len(unique), n_proc)
+            mat[idxs] = gathered[p, : idxs.shape[0]]
+        index = {path: i for i, path in enumerate(unique)}
+        return mat[[index[p] for p in genome_paths]]
+
     def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
         logger.info(
             "Sketching MinHash representations of %d genomes on device ..",
             len(genome_paths))
         with timing.stage("sketch-minhash"):
-            from galah_tpu.io.prefetch import (
-                probe_and_prefetch,
-                process_stream,
-            )
+            from galah_tpu.parallel import distributed
 
-            # cache misses: ingestion prefetched on host threads while
-            # the device sketches the previous genome
-            by_path, miss_iter = probe_and_prefetch(
-                genome_paths, self.store.get_cached, read_genome,
-                depth=max(2, self.threads))
-            # worker threads only COMPUTE sketches; the consumer loop
-            # below is the single writer into the store and disk cache
-            for p, s in process_stream(
-                    miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
-                    self.store.sketch_batch_only,
-                    lambda _path, g: self.store.sketch_only(g),
-                    batched=hashing.device_transfer_bound(),
-                    workers=self.threads):
-                by_path[p] = self.store.insert(p, s)
-            sketches = [by_path[p] for p in genome_paths]
-            mat = sketch_matrix(sketches, sketch_size=self.sketch_size)
+            n_proc = distributed.process_count()
+            if n_proc > 1:
+                mat = self._sketch_matrix_multihost(genome_paths, n_proc)
+            else:
+                by_path = self._sketch_paths(genome_paths)
+                sketches = [by_path[p] for p in genome_paths]
+                mat = sketch_matrix(sketches,
+                                    sketch_size=self.sketch_size)
         logger.info("Computing tiled all-pairs Mash ANI ..")
         with timing.stage("pairwise-minhash"):
             # threshold_pairs auto-selects the column-sharded SPMD

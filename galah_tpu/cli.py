@@ -198,6 +198,12 @@ def run_cluster(args) -> int:
     from galah_tpu.genome_inputs import parse_genome_inputs
     from galah_tpu.io import diskcache
     from galah_tpu.outputs import setup_outputs, write_outputs
+    from galah_tpu.parallel import distributed
+
+    # Join the multi-host runtime when the standard JAX cluster env
+    # vars are set (docs/DISTRIBUTED.md); a no-op otherwise. Every
+    # host computes identical clusters; only process 0 writes outputs.
+    distributed.initialize()
 
     timing.reset()
     genomes = parse_genome_inputs(
@@ -225,8 +231,14 @@ def run_cluster(args) -> int:
         return 1
     genomes = clusterer.genome_paths
 
-    # Open output handles before compute (fail fast)
-    handles = setup_outputs(
+    # Open output handles before compute (fail fast). On multi-host
+    # runs only process 0 writes — every host computes the identical
+    # clusters, and N processes writing the same files would race.
+    # Non-writers still VALIDATE the paths (without touching them) so
+    # a bad output path fails every process before the first
+    # collective instead of stalling the others in it.
+    is_writer = distributed.process_index() == 0
+    output_args = dict(
         cluster_definition=args.output_cluster_definition,
         representative_fasta_directory=(
             args.output_representative_fasta_directory),
@@ -234,6 +246,13 @@ def run_cluster(args) -> int:
             args.output_representative_fasta_directory_copy),
         representative_list=args.output_representative_list,
     )
+    if is_writer:
+        handles = setup_outputs(**output_args)
+    else:
+        from galah_tpu.outputs import validate_output_paths
+
+        validate_output_paths(**output_args)
+        handles = None
 
     ckpt = None
     if getattr(args, "checkpoint_dir", None):
@@ -242,8 +261,20 @@ def run_cluster(args) -> int:
             run_fingerprint,
         )
 
+        # Multi-host: each process persists under its own subdirectory
+        # — N processes appending to one shared checkpoint would
+        # interleave/corrupt it, and gating persistence to one process
+        # would desynchronize the collective-participating distance
+        # pass on resume (the loader skips it, the others don't).
+        # Per-process dirs keep every host symmetric.
+        ckpt_dir = args.checkpoint_dir
+        if distributed.process_count() > 1:
+            import os as _os
+
+            ckpt_dir = _os.path.join(
+                ckpt_dir, f"proc_{distributed.process_index()}")
         ckpt = ClusterCheckpoint(
-            args.checkpoint_dir,
+            ckpt_dir,
             run_fingerprint(
                 genomes, args.precluster_method, args.cluster_method,
                 parse_percentage(args.ani, "--ani"),
@@ -259,9 +290,12 @@ def run_cluster(args) -> int:
         clusters = clusterer.cluster()
     logger.info("Found %d genome clusters", len(clusters))
 
-    with timing.stage("write-outputs"):
-        write_outputs(handles, clusters, genomes)
-    logger.info("Finished printing genome clusters")
+    if is_writer:
+        with timing.stage("write-outputs"):
+            write_outputs(handles, clusters, genomes)
+        logger.info("Finished printing genome clusters")
+    else:
+        logger.info("Non-zero process: outputs written by process 0")
     timing.GLOBAL.report(logger)
     return 0
 

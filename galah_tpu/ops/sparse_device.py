@@ -62,16 +62,23 @@ def _batch_pair_stats(jmat: jax.Array, pi: jax.Array, pj: jax.Array,
 def _make_sharded_batch_stats(mesh: Mesh, sketch_size: int):
     """SPMD twin: the candidate batch is sharded over the mesh axis,
     the sketch matrix is replicated; each device evaluates its slice
-    of the pair list. No collective is needed — the outputs are
-    per-pair and come back shard-concatenated."""
+    of the pair list. The per-pair outputs are all-gathered back to a
+    replicated (B,) layout so a multi-host run (where P("i") shards
+    are not host-addressable) reads the identical arrays on every
+    host."""
 
     def spmd(jmat, pi, pj):
-        return _batch_pair_stats(jmat, pi, pj, sketch_size)
+        c, t = _batch_pair_stats(jmat, pi, pj, sketch_size)
+        return (jax.lax.all_gather(c, "i", tiled=True),
+                jax.lax.all_gather(t, "i", tiled=True))
 
+    # check_vma off: the outputs ARE replicated post-gather, but the
+    # vma type system cannot express that for P() out_specs.
     fn = shard_map(
         spmd, mesh=mesh,
         in_specs=(P(None, None), P("i"), P("i")),
-        out_specs=(P("i"), P("i")),
+        out_specs=(P(), P()),
+        check_vma=False,
     )
     return jax.jit(fn)
 

@@ -49,6 +49,37 @@ def _setup_directory(path: Optional[str], argument: str) -> Optional[str]:
     return path
 
 
+def validate_output_paths(
+    cluster_definition: Optional[str] = None,
+    representative_fasta_directory: Optional[str] = None,
+    representative_fasta_directory_copy: Optional[str] = None,
+    representative_list: Optional[str] = None,
+) -> None:
+    """Fail-fast writability checks WITHOUT touching the targets.
+
+    Multi-host non-writer processes run this instead of setup_outputs:
+    they must fail before the first collective exactly when the writer
+    does (same shared filesystem, same answer), but must not open/
+    truncate the files process 0 will write.
+    """
+    import os
+
+    for p in (cluster_definition, representative_list):
+        if p:
+            d = os.path.dirname(os.path.abspath(p)) or "."
+            if not os.path.isdir(d) or not os.access(d, os.W_OK):
+                raise OSError(f"output path not writable: {p}")
+            if os.path.exists(p) and not os.access(p, os.W_OK):
+                raise OSError(f"output file not writable: {p}")
+    for p in (representative_fasta_directory,
+              representative_fasta_directory_copy):
+        if p:
+            parent = os.path.dirname(os.path.abspath(p)) or "."
+            target = p if os.path.isdir(p) else parent
+            if not os.path.isdir(target) or not os.access(target, os.W_OK):
+                raise OSError(f"output directory not writable: {p}")
+
+
 def setup_outputs(
     cluster_definition: Optional[str] = None,
     representative_fasta_directory: Optional[str] = None,

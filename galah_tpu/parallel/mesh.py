@@ -142,9 +142,13 @@ def _sharded_blocked_extract(
     stripes against its contiguous column range tile by tile (lax.cond
     skips tiles entirely below the diagonal), applies `stripe_mask`
     plus the upper-triangle/bounds mask, and compacts passing entries
-    to a fixed capacity on device. Yields (gi, gj, payloads) numpy
-    arrays per (row block, device); overflow retry policy comes from
-    ops/compact.iter_blocks.
+    to a fixed capacity on device. The compacted per-device outputs are
+    all-gathered inside the SPMD program and returned REPLICATED, so a
+    multi-host run (where per-device shards are not host-addressable)
+    reads the same arrays as a single host — every host sees every
+    device's candidates and produces the identical pair set. Yields
+    (gi, gj, payloads) numpy arrays per (row block, device); overflow
+    retry policy comes from ops/compact.iter_blocks.
     """
     from galah_tpu.ops.compact import iter_blocks
 
@@ -189,17 +193,26 @@ def _sharded_blocked_extract(
         count = jnp.sum(mask.astype(jnp.int32))
         (flat_idx,) = jnp.nonzero(mask.ravel(), size=cap, fill_value=-1)
         safe = jnp.maximum(flat_idx, 0)
-        payloads = tuple(jnp.take(s.ravel(), safe)[None] for s in stripes)
-        return (flat_idx[None], *payloads, count[None])
+        payloads = tuple(jnp.take(s.ravel(), safe) for s in stripes)
+        # Replicate the (tiny) compacted results to every device so a
+        # multi-host run can read them from any host: (n_dev, cap) per
+        # payload, (n_dev,) counts.
+        gather = functools.partial(jax.lax.all_gather, axis_name="i")
+        return (gather(flat_idx), *map(gather, payloads), gather(count))
 
     @functools.partial(jax.jit, static_argnames=("cap",))
     def run_block(*args, cap):
         in_specs = tuple(P(*([None] * a.ndim)) for a in arrays) + (P(),)
+        # check_vma off: the outputs ARE replicated (each is an
+        # all_gather result, identical on every device), but the vma
+        # type system cannot express post-gather invariance for P()
+        # out_specs (pcast has no varying->invariant direction).
         fn = shard_map(
             functools.partial(lambda *a, cap: spmd(*a, cap), cap=cap),
             mesh=mesh,
             in_specs=in_specs,
-            out_specs=tuple(P("i") for _ in range(n_payload + 2)),
+            out_specs=tuple(P() for _ in range(n_payload + 2)),
+            check_vma=False,
         )
         return fn(*args)
 

@@ -63,6 +63,30 @@ def main() -> int:
     count = sharded_pair_count(mat, k=21, min_ani=0.99, mesh=mesh,
                                col_tile=8)
     print(f"COUNT {pid} {count}", flush=True)
+
+    # Optional end-to-end mode: cluster a shared genome directory with
+    # per-host ingestion (MinHashPreclusterer splits FASTA reading +
+    # sketching across hosts and exchanges sketch rows); every process
+    # must print the identical composition.
+    if len(sys.argv) > 4:
+        import glob
+        import json
+
+        from galah_tpu.backends import (
+            MinHashPreclusterer,
+            ProfileStore,
+            SkaniEquivalentClusterer,
+        )
+        from galah_tpu.cluster import cluster
+
+        paths = sorted(glob.glob(os.path.join(sys.argv[4], "*.fna")))
+        pre = MinHashPreclusterer(min_ani=0.9)
+        cl = SkaniEquivalentClusterer(
+            threshold=0.95, min_aligned_fraction=0.2,
+            store=ProfileStore(k=15))
+        clusters = cluster(paths, pre, cl)
+        got = sorted(sorted(c) for c in clusters)
+        print(f"CLUSTERS {pid} {json.dumps(got)}", flush=True)
     return 0
 
 

@@ -77,3 +77,71 @@ def test_two_process_distributed_pair_count():
     assert set(counts) == {0, 1}, f"missing worker output: {outs}"
     expected = _expected_count()
     assert counts[0] == counts[1] == expected
+
+
+def _write_family_genomes(root):
+    """2 families x 2 members of 6 kb genomes -> expected [[0,1],[2,3]]."""
+    rng = np.random.default_rng(7)
+    bases = np.array(list("ACGT"))
+    paths = []
+    for fam in range(2):
+        base = rng.integers(0, 4, size=6000)
+        for member in range(2):
+            codes = base.copy()
+            if member:
+                sites = rng.random(6000) < 0.005
+                codes[sites] = (
+                    codes[sites]
+                    + rng.integers(1, 4, size=int(sites.sum()))) % 4
+            p = os.path.join(root, f"fam{fam}_m{member}.fna")
+            with open(p, "w") as f:
+                f.write(">c1\n" + "".join(bases[codes]) + "\n")
+            paths.append(p)
+    return paths
+
+
+def test_two_process_end_to_end_cluster(tmp_path):
+    """Full cluster() across 2 real processes with per-host FASTA
+    ingestion (the MinHash backend splits reading + sketching by
+    host_shard and exchanges sketch rows): both processes must produce
+    the identical, correct family composition."""
+    import json
+
+    gdir = str(tmp_path / "genomes")
+    os.makedirs(gdir)
+    _write_family_genomes(gdir)
+
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # isolate the disk sketch cache per test run
+    env["GALAH_TPU_CACHE"] = str(tmp_path / "cache")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coord, "2", str(pid), gdir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=REPO)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, (
+                f"worker failed rc={p.returncode}\nstdout:{out}\n"
+                f"stderr:{err[-2000:]}")
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    comps = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("CLUSTERS"):
+                _, pid, comp = line.split(None, 2)
+                comps[int(pid)] = json.loads(comp)
+    assert set(comps) == {0, 1}, f"missing worker output: {outs}"
+    assert comps[0] == comps[1] == [[0, 1], [2, 3]], comps
