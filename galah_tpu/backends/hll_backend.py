@@ -41,50 +41,74 @@ class HLLPreclusterer(PreclusterBackend):
     def method_name(self) -> str:
         return "dashing"
 
+    def _sketch_paths(self, paths: Sequence[str]) -> dict:
+        """path -> (2^p,) register row for (deduped) paths: cache probe
+        + prefetch + batched device sketching; the consumer loop is the
+        single writer into the disk cache."""
+        from galah_tpu.io.fasta import read_genome
+        from galah_tpu.io.prefetch import (
+            probe_and_prefetch,
+            process_stream,
+        )
+        from galah_tpu.ops.hashing import (
+            BATCH_BUDGET,
+            device_transfer_bound,
+        )
+
+        params = {"p": self.p, "k": self.k, "seed": self.seed,
+                  "algo": self.algo}
+
+        def probe(path):
+            entry = self.cache.load(path, "hll", params)
+            return entry["regs"] if entry is not None else None
+
+        by_path, miss_iter = probe_and_prefetch(
+            paths, probe, read_genome, depth=max(2, self.threads))
+        for path, row in process_stream(
+                miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
+                lambda buf: hll.hll_sketch_genomes_batch(
+                    [g for _, g in buf], p=self.p, k=self.k,
+                    seed=self.seed, algo=self.algo),
+                lambda _path, g: hll.hll_sketch_genome(
+                    g, p=self.p, k=self.k, seed=self.seed,
+                    algo=self.algo),
+                batched=device_transfer_bound(),
+                workers=self.threads):
+            by_path[path] = row
+            self.cache.store(path, "hll", params, {"regs": row})
+        return by_path
+
     def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
         import numpy as np
 
-        from galah_tpu.io.fasta import read_genome
+        from galah_tpu.parallel import distributed
 
         n = len(genome_paths)
         logger.info("Sketching HLL registers of %d genomes on device ..", n)
-        params = {"p": self.p, "k": self.k, "seed": self.seed,
-                  "algo": self.algo}
         regs = np.zeros((n, 1 << self.p), dtype=np.uint8)
+        index: "dict[str, list[int]]" = {}
+        for i, path in enumerate(genome_paths):
+            index.setdefault(path, []).append(i)
         with timing.stage("sketch-hll"):
-            from galah_tpu.io.prefetch import probe_and_prefetch
-
-            index: "dict[str, list[int]]" = {}
-            for i, path in enumerate(genome_paths):
-                index.setdefault(path, []).append(i)
-
-            def probe(path):
-                entry = self.cache.load(path, "hll", params)
-                return entry["regs"] if entry is not None else None
-
-            hits, miss_iter = probe_and_prefetch(
-                genome_paths, probe, read_genome,
-                depth=max(2, self.threads))
-            for path, row in hits.items():
-                regs[index[path]] = row
-            from galah_tpu.io.prefetch import process_stream
-            from galah_tpu.ops.hashing import (
-                BATCH_BUDGET,
-                device_transfer_bound,
-            )
-
-            for path, row in process_stream(
-                    miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
-                    lambda buf: hll.hll_sketch_genomes_batch(
-                        [g for _, g in buf], p=self.p, k=self.k,
-                        seed=self.seed, algo=self.algo),
-                    lambda _path, g: hll.hll_sketch_genome(
-                        g, p=self.p, k=self.k, seed=self.seed,
-                        algo=self.algo),
-                    batched=device_transfer_bound(),
-                    workers=self.threads):
-                regs[index[path]] = row
-                self.cache.store(path, "hll", params, {"regs": row})
+            unique = list(index)
+            if distributed.process_count() > 1:
+                # Per-host ingestion, same shape as the MinHash
+                # backend: sketch only this host's strided shard,
+                # exchange the (tiny) register rows via the shared
+                # protocol, reassemble identically on every host.
+                mine = distributed.host_shard(unique)
+                by_path = self._sketch_paths(mine)
+                local = (np.stack([by_path[p] for p in mine])
+                         if mine else
+                         np.zeros((0, 1 << self.p), dtype=np.uint8))
+                full = distributed.allgather_host_rows(
+                    len(unique), local, fill=np.uint8(0))
+                for row_i, path in enumerate(unique):
+                    regs[index[path]] = full[row_i]
+            else:
+                by_path = self._sketch_paths(genome_paths)
+                for path, row in by_path.items():
+                    regs[index[path]] = row
 
         logger.info("Computing tiled all-pairs HLL ANI ..")
         with timing.stage("pairwise-hll"):

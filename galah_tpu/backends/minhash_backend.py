@@ -152,18 +152,16 @@ class MinHashPreclusterer(PreclusterBackend):
             by_path[p] = self.store.insert(p, s)
         return by_path
 
-    def _sketch_matrix_multihost(self, genome_paths: Sequence[str],
-                                 n_proc: int):
+    def _sketch_matrix_multihost(self, genome_paths: Sequence[str]):
         """Per-host ingestion: each host reads + sketches only its
         strided shard of the unique genome list (FASTA IO and hashing
-        scale linearly with hosts), then the padded sketch rows are
-        exchanged with one process_allgather and reassembled into the
-        full matrix on every host — identical on all hosts, so the
+        scale linearly with hosts), then the sketch rows are exchanged
+        (parallel/distributed.allgather_host_rows) and reassembled into
+        the full matrix on every host — identical everywhere, so the
         downstream screen/engine decisions are too. The full matrix is
         K*8 bytes per genome (~8 KB at K=1000): 50k genomes is ~400 MB
         per host, far below the per-genome FASTA cost being split."""
         import numpy as np
-        from jax.experimental import multihost_utils
 
         from galah_tpu.ops.constants import SENTINEL
         from galah_tpu.parallel import distributed
@@ -174,17 +172,8 @@ class MinHashPreclusterer(PreclusterBackend):
         local = sketch_matrix([by_path[p] for p in mine],
                               sketch_size=self.sketch_size) \
             if mine else np.zeros((0, self.sketch_size), np.uint64)
-
-        per = -(-len(unique) // n_proc)
-        padded = np.full((per, self.sketch_size), np.uint64(SENTINEL),
-                         dtype=np.uint64)
-        padded[: local.shape[0]] = local
-        gathered = np.asarray(
-            multihost_utils.process_allgather(padded, tiled=False))
-        mat = np.empty((len(unique), self.sketch_size), dtype=np.uint64)
-        for p in range(n_proc):
-            idxs = np.arange(p, len(unique), n_proc)
-            mat[idxs] = gathered[p, : idxs.shape[0]]
+        mat = distributed.allgather_host_rows(
+            len(unique), local, fill=np.uint64(SENTINEL))
         index = {path: i for i, path in enumerate(unique)}
         return mat[[index[p] for p in genome_paths]]
 
@@ -195,9 +184,8 @@ class MinHashPreclusterer(PreclusterBackend):
         with timing.stage("sketch-minhash"):
             from galah_tpu.parallel import distributed
 
-            n_proc = distributed.process_count()
-            if n_proc > 1:
-                mat = self._sketch_matrix_multihost(genome_paths, n_proc)
+            if distributed.process_count() > 1:
+                mat = self._sketch_matrix_multihost(genome_paths)
             else:
                 by_path = self._sketch_paths(genome_paths)
                 sketches = [by_path[p] for p in genome_paths]

@@ -266,7 +266,6 @@ def run_cluster(args) -> int:
         # interleave/corrupt it, and gating persistence to one process
         # would desynchronize the collective-participating distance
         # pass on resume (the loader skips it, the others don't).
-        # Per-process dirs keep every host symmetric.
         ckpt_dir = args.checkpoint_dir
         if distributed.process_count() > 1:
             import os as _os
@@ -283,6 +282,18 @@ def run_cluster(args) -> int:
                     args.min_aligned_fraction, "--min-aligned-fraction"),
                 fragment_length=args.fragment_length,
                 backend_params=clusterer.backend_params))
+        # All-or-nothing resume across hosts: a crash can land between
+        # two hosts' checkpoint saves, and resuming from uneven state
+        # would deadlock the collective-participating distance pass
+        # (the host with a checkpoint skips it, the others enter it).
+        # If the per-process states differ, every host drops its
+        # resumable state and recomputes symmetrically.
+        if distributed.process_count() > 1 and not \
+                distributed.tokens_agree(ckpt.state_token()):
+            logger.warning(
+                "Checkpoint state differs across hosts; dropping it "
+                "and recomputing so all hosts stay in lockstep")
+            ckpt.reset_state()
         clusterer.checkpoint = ckpt
 
     logger.info("Clustering %d genomes ..", len(genomes))

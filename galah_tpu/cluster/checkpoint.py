@@ -93,6 +93,34 @@ class ClusterCheckpoint:
     def enabled(self) -> bool:
         return self.path is not None
 
+    def state_token(self) -> bytes:
+        """Digest of the resumable state (distances file bytes + the
+        completed-precluster ids): hosts compare these to make a
+        multi-host resume all-or-nothing (cli.py), since resuming from
+        UNEVEN checkpoints would desynchronize the collective-
+        participating distance pass across processes."""
+        h = hashlib.sha256()
+        if not self.enabled:
+            return h.digest()
+        fn = os.path.join(self.path, _DISTANCES)
+        if os.path.exists(fn):
+            with open(fn, "rb") as f:
+                h.update(f.read())
+        done = sorted(self.load_completed())
+        h.update(json.dumps(done).encode())
+        return h.digest()
+
+    def reset_state(self) -> None:
+        """Drop the resumable state (keep the fingerprint): the next
+        run recomputes from scratch on every host, symmetrically."""
+        if not self.enabled:
+            return
+        for name in (_DISTANCES, _CLUSTERS):
+            try:
+                os.unlink(os.path.join(self.path, name))
+            except FileNotFoundError:
+                pass
+
     # -- precluster distance pass ------------------------------------
 
     def load_distances(self) -> Optional[PairDistanceCache]:

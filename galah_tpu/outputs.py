@@ -49,35 +49,67 @@ def _setup_directory(path: Optional[str], argument: str) -> Optional[str]:
     return path
 
 
+def _nearest_existing_dir(path: str) -> str:
+    """Closest existing ancestor of `path` (os.makedirs would create
+    everything below it)."""
+    d = os.path.abspath(path)
+    while not os.path.exists(d):
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return d
+
+
 def validate_output_paths(
     cluster_definition: Optional[str] = None,
     representative_fasta_directory: Optional[str] = None,
     representative_fasta_directory_copy: Optional[str] = None,
     representative_list: Optional[str] = None,
 ) -> None:
-    """Fail-fast writability checks WITHOUT touching the targets.
+    """Fail-fast checks mirroring setup_outputs WITHOUT touching the
+    targets.
 
     Multi-host non-writer processes run this instead of setup_outputs:
     they must fail before the first collective exactly when the writer
     does (same shared filesystem, same answer), but must not open/
-    truncate the files process 0 will write.
+    truncate the files or create the directories process 0 will. The
+    conditions below are setup_outputs' own, case for case: file
+    outputs need an existing, writable direct parent and must not be
+    directories; directory outputs must be empty if they exist
+    (_setup_directory), else creatable (nearest existing ancestor
+    writable, since makedirs creates intermediates).
     """
-    import os
-
     for p in (cluster_definition, representative_list):
         if p:
+            if os.path.isdir(p):
+                raise ValueError(
+                    f"output path {p} is a directory")
             d = os.path.dirname(os.path.abspath(p)) or "."
             if not os.path.isdir(d) or not os.access(d, os.W_OK):
                 raise OSError(f"output path not writable: {p}")
             if os.path.exists(p) and not os.access(p, os.W_OK):
                 raise OSError(f"output file not writable: {p}")
-    for p in (representative_fasta_directory,
-              representative_fasta_directory_copy):
-        if p:
-            parent = os.path.dirname(os.path.abspath(p)) or "."
-            target = p if os.path.isdir(p) else parent
-            if not os.path.isdir(target) or not os.access(target, os.W_OK):
-                raise OSError(f"output directory not writable: {p}")
+    for p, argument in (
+            (representative_fasta_directory,
+             "output-representative-fasta-directory"),
+            (representative_fasta_directory_copy,
+             "output-representative-fasta-directory-copy")):
+        if not p:
+            continue
+        if os.path.exists(p):
+            if not os.path.isdir(p):
+                raise ValueError(
+                    f"The {argument} path specified ({p}) exists but "
+                    "is not a directory")
+            if os.listdir(p):
+                raise ValueError(
+                    f"The {argument} specified ({p}) exists and is "
+                    "not empty")
+        else:
+            anc = _nearest_existing_dir(p)
+            if not os.path.isdir(anc) or not os.access(anc, os.W_OK):
+                raise OSError(f"output directory not creatable: {p}")
 
 
 def setup_outputs(

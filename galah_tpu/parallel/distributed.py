@@ -87,6 +87,50 @@ def global_mesh(axis_name: str = "i") -> Mesh:
     return Mesh(np.array(jax.devices()), (axis_name,))
 
 
+def allgather_host_rows(n_unique: int, local_rows: "np.ndarray",
+                        fill=0) -> "np.ndarray":
+    """Exchange per-host strided-shard rows into the full row matrix.
+
+    `local_rows` are this host's rows for `host_shard(range(n_unique))`
+    in shard order; every host receives the identical (n_unique, ...)
+    array. The one pad/process_allgather/strided-reassemble protocol
+    shared by the sketching backends — the reassembly stride MUST
+    mirror host_shard's `items[rank::count]`, so it lives next to it.
+    """
+    n_proc = process_count()
+    per = -(-n_unique // n_proc)
+    padded = np.full((per, *local_rows.shape[1:]), fill,
+                     dtype=local_rows.dtype)
+    padded[: local_rows.shape[0]] = local_rows
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(
+        multihost_utils.process_allgather(padded, tiled=False))
+    out = np.empty((n_unique, *local_rows.shape[1:]),
+                   dtype=local_rows.dtype)
+    for p in range(n_proc):
+        idxs = np.arange(p, n_unique, n_proc)
+        out[idxs] = gathered[p, : idxs.shape[0]]
+    return out
+
+
+def tokens_agree(token: bytes) -> bool:
+    """True iff every process passed the identical token (fixed-length
+    digest; callers hash variable-size state first). Used to make
+    checkpoint resume all-or-nothing across hosts."""
+    import hashlib
+
+    digest = np.frombuffer(
+        hashlib.sha256(token).digest(), dtype=np.uint8).copy()
+    if process_count() == 1:
+        return True
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(
+        multihost_utils.process_allgather(digest, tiled=False))
+    return bool((gathered == gathered[0]).all())
+
+
 def global_sketch_matrix(
     local_rows: np.ndarray,
     global_n: int,

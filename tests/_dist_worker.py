@@ -79,14 +79,20 @@ def main() -> int:
         )
         from galah_tpu.cluster import cluster
 
+        from galah_tpu.backends import HLLPreclusterer
+
         paths = sorted(glob.glob(os.path.join(sys.argv[4], "*.fna")))
-        pre = MinHashPreclusterer(min_ani=0.9)
+        store = ProfileStore(k=15)
         cl = SkaniEquivalentClusterer(
-            threshold=0.95, min_aligned_fraction=0.2,
-            store=ProfileStore(k=15))
-        clusters = cluster(paths, pre, cl)
+            threshold=0.95, min_aligned_fraction=0.2, store=store)
+        clusters = cluster(paths, MinHashPreclusterer(min_ani=0.9), cl)
         got = sorted(sorted(c) for c in clusters)
         print(f"CLUSTERS {pid} {json.dumps(got)}", flush=True)
+
+        # dashing-equivalent precluster path, same per-host ingestion
+        clusters2 = cluster(paths, HLLPreclusterer(min_ani=0.9), cl)
+        got2 = sorted(sorted(c) for c in clusters2)
+        print(f"CLUSTERS_HLL {pid} {json.dumps(got2)}", flush=True)
     return 0
 
 

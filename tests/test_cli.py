@@ -341,3 +341,43 @@ def test_dist_min_ani_filters(tmp_path):
     ])
     assert rc == 0
     assert out.read_text() == ""  # 0.98 < 0.99: filtered out
+
+
+def test_validate_output_paths_mirrors_setup(tmp_path):
+    """Non-writer validation must agree with setup_outputs case for
+    case — disagreement would stall multi-host runs in the first
+    collective (one process exits, the others wait on it)."""
+    import pytest as _pytest
+
+    from galah_tpu.outputs import setup_outputs, validate_output_paths
+
+    nonempty = tmp_path / "nonempty"
+    nonempty.mkdir()
+    (nonempty / "x").write_text("x")
+    nested = tmp_path / "a" / "b" / "c"
+    filedir = tmp_path / "iamadir"
+    filedir.mkdir()
+
+    cases = [
+        # (kwargs, should_fail)
+        ({"representative_fasta_directory": str(nonempty)}, True),
+        ({"representative_fasta_directory": str(nested)}, False),
+        ({"cluster_definition": str(filedir)}, True),
+        ({"cluster_definition": str(tmp_path / "missing" / "f.tsv")},
+         True),
+        ({"cluster_definition": str(tmp_path / "ok.tsv")}, False),
+    ]
+    for kwargs, should_fail in cases:
+        if should_fail:
+            with _pytest.raises((OSError, ValueError)):
+                validate_output_paths(**kwargs)
+            with _pytest.raises((OSError, ValueError)):
+                setup_outputs(**kwargs)
+        else:
+            validate_output_paths(**kwargs)  # must not raise
+            setup_outputs(**kwargs)          # and setup agrees
+            # reset for repeatability of the nested-dir case
+            import shutil
+
+            if "representative_fasta_directory" in kwargs:
+                shutil.rmtree(tmp_path / "a")

@@ -112,3 +112,42 @@ def test_sharded_hll_threshold_pairs_matches_single_device():
     assert (6, 31) in got
     for key in got:
         assert abs(got[key] - ref[key]) < 1e-6
+
+
+def test_allgather_host_rows_single_process():
+    """Single-process: the exchange protocol is an identity (one shard
+    holds every row)."""
+    import numpy as np
+
+    from galah_tpu.parallel import distributed
+
+    rows = np.arange(12, dtype=np.uint64).reshape(4, 3)
+    out = distributed.allgather_host_rows(4, rows, fill=np.uint64(0))
+    np.testing.assert_array_equal(out, rows)
+
+
+def test_tokens_agree_single_process():
+    from galah_tpu.parallel import distributed
+
+    assert distributed.tokens_agree(b"anything")
+
+
+def test_checkpoint_state_token_and_reset(tmp_path):
+    """The token changes with resumable state and reset drops it."""
+    from galah_tpu.cluster.cache import PairDistanceCache
+    from galah_tpu.cluster.checkpoint import ClusterCheckpoint
+
+    ck = ClusterCheckpoint(str(tmp_path / "ck"), "fp")
+    t0 = ck.state_token()
+    cache = PairDistanceCache()
+    cache.insert((0, 1), 0.99)
+    ck.save_distances(cache)
+    t1 = ck.state_token()
+    assert t1 != t0
+    ck.save_precluster(0, [[0, 1]])
+    t2 = ck.state_token()
+    assert t2 != t1
+    ck.reset_state()
+    assert ck.state_token() == t0
+    assert ck.load_distances() is None
+    assert ck.load_completed() == {}

@@ -138,10 +138,16 @@ def test_two_process_end_to_end_cluster(tmp_path):
                 p.kill()
 
     comps = {}
+    comps_hll = {}
     for out in outs:
         for line in out.splitlines():
-            if line.startswith("CLUSTERS"):
+            if line.startswith("CLUSTERS_HLL"):
+                _, pid, comp = line.split(None, 2)
+                comps_hll[int(pid)] = json.loads(comp)
+            elif line.startswith("CLUSTERS"):
                 _, pid, comp = line.split(None, 2)
                 comps[int(pid)] = json.loads(comp)
     assert set(comps) == {0, 1}, f"missing worker output: {outs}"
     assert comps[0] == comps[1] == [[0, 1], [2, 3]], comps
+    assert set(comps_hll) == {0, 1}, f"missing HLL output: {outs}"
+    assert comps_hll[0] == comps_hll[1] == [[0, 1], [2, 3]], comps_hll
