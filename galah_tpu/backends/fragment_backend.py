@@ -270,45 +270,133 @@ class SkaniPreclusterer(PreclusterBackend):
     def method_name(self) -> str:
         return "skani"
 
-    def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
-        n = len(genome_paths)
-        logger.info("Profiling %d genomes for skani-style preclustering ..",
-                    n)
-        with timing.stage("profile-genomes"):
-            with self.store.reserve(n):
-                profiles = self.store.get_many(genome_paths)
-
-        # Marker matrix: pad each genome's marker sketch to a common width.
-        m = max(max((p.markers.shape[0] for p in profiles), default=1), 1)
-        m = -(-m // 64) * 64
+    def _marker_matrix(self, profiles, n: int, width: int = 0):
+        """Pad per-genome marker sketches to a common-width matrix
+        (`width` forces the column count; 0 = fit to these profiles —
+        the multihost path forces the allgather-agreed global width so
+        both paths share this one padding loop)."""
+        m = width or -(-max(max(
+            (p.markers.shape[0] for p in profiles), default=1), 1)
+            // 64) * 64
         mat = np.full((n, m), np.uint64(SENTINEL), dtype=np.uint64)
         counts = np.zeros(n, dtype=np.int64)
         for i, p in enumerate(profiles):
             cnt = min(p.markers.shape[0], m)
             mat[i, :cnt] = p.markers[:cnt]
             counts[i] = cnt
+        return mat, counts
+
+    def _marker_matrix_multihost(self, genome_paths: Sequence[str]):
+        """Per-host profiling for the marker screen: each host profiles
+        only its strided shard and exchanges the (small) marker rows —
+        the global width is agreed with one scalar allgather first.
+        Returns (mat, counts, warm) where `warm` maps this host's
+        global genome index -> its built profile, handed to phase B so
+        the shard's profiles survive regardless of LRU capacity or
+        disk-cache availability."""
+        from jax.experimental import multihost_utils
+
+        from galah_tpu.parallel import distributed
+
+        n = len(genome_paths)
+        mine_idx = distributed.host_shard(list(range(n)))
+        with timing.stage("profile-genomes"):
+            with self.store.reserve(max(len(mine_idx), 1)):
+                mine = self.store.get_many(
+                    [genome_paths[i] for i in mine_idx])
+        local_max = max(
+            max((p.markers.shape[0] for p in mine), default=1), 1)
+        maxes = np.asarray(multihost_utils.process_allgather(
+            np.array([local_max], dtype=np.int64), tiled=False))
+        m = -(-int(maxes.max()) // 64) * 64
+
+        local_mat, local_counts = self._marker_matrix(
+            mine, len(mine), width=m)
+        local = np.concatenate(
+            [local_mat, local_counts.astype(np.uint64)[:, None]], axis=1)
+        full = distributed.allgather_host_rows(
+            n, local, fill=np.uint64(SENTINEL))
+        warm = dict(zip(mine_idx, mine))
+        return (np.ascontiguousarray(full[:, :m]),
+                full[:, m].astype(np.int64), warm)
+
+    def _exact_ani_multihost(self, genome_paths, pairs, warm):
+        """Exact ANI over the screened pairs, sharded by host: each
+        host evaluates pairs[rank::P], reusing phase A's `warm`
+        profiles for its own shard's genomes and profiling only the
+        cross-host endpoints (the shared disk cache makes those warm
+        too when enabled), then the per-pair ANIs are exchanged as one
+        float row matrix. Every host ends with the identical result
+        vector."""
+        from galah_tpu.parallel import distributed
+
+        my_pairs = distributed.host_shard(pairs)
+        endpoints = list(dict.fromkeys(
+            g for pair in my_pairs for g in pair))
+        missing = [g for g in endpoints if g not in warm]
+        with timing.stage("profile-genomes"):
+            with self.store.reserve(max(len(missing), 1)):
+                prof = dict(zip(missing, self.store.get_many(
+                    [genome_paths[g] for g in missing])))
+        prof.update((g, warm[g]) for g in endpoints if g in warm)
+        results = fragment_ani.bidirectional_ani_batch(
+            [(prof[i], prof[j]) for i, j in my_pairs],
+            min_aligned_frac=self.min_aligned_fraction,
+            threads=self.store.threads)
+        local = np.full((len(my_pairs), 1), np.nan, dtype=np.float64)
+        for row_i, (ani, _, _) in enumerate(results):
+            if ani is not None:
+                local[row_i, 0] = ani
+        full = distributed.allgather_host_rows(
+            len(pairs), local, fill=np.nan)
+        return full[:, 0]
+
+    def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
+        from galah_tpu.parallel import distributed
+
+        n = len(genome_paths)
+        n_proc = distributed.process_count()
+        logger.info("Profiling %d genomes for skani-style preclustering ..",
+                    n)
+        warm = {}
+        if n_proc > 1:
+            mat, counts, warm = self._marker_matrix_multihost(
+                genome_paths)
+            profiles = None
+        else:
+            with timing.stage("profile-genomes"):
+                with self.store.reserve(n):
+                    profiles = self.store.get_many(genome_paths)
+            mat, counts = self._marker_matrix(profiles, n)
 
         # Blocked screening: ONE device dispatch per row block (the same
         # extraction pattern as threshold_pairs — dispatch count scales
         # O(N / row_tile), not O((N / tile)^2); auto-shards the columns
-        # over a multi-device mesh).
+        # over a multi-device mesh). Above the sparse crossover the
+        # host collision screen runs instead (exact, any backend).
         logger.info("Screening all pairs by marker containment ..")
         c_floor = self.SCREEN_IDENTITY ** self.store.k
         with timing.stage("marker-screen"):
             pairs = screen_pairs(mat, counts, c_floor)
-        ii = [p[0] for p in pairs]
-        jj = [p[1] for p in pairs]
         logger.info("%d pairs passed screening; computing exact ANI ..",
-                    len(ii))
+                    len(pairs))
 
         cache = PairDistanceCache()
-        results = fragment_ani.bidirectional_ani_batch(
-            [(profiles[i], profiles[j]) for i, j in zip(ii, jj)],
-            min_aligned_frac=self.min_aligned_fraction,
-            threads=self.store.threads)
-        for i, j, (ani, _, _) in zip(ii, jj, results):
-            if ani is not None and ani >= self.threshold:
-                cache.insert((i, j), ani)
+        if n_proc > 1:
+            if pairs:
+                anis = self._exact_ani_multihost(genome_paths, pairs,
+                                                 warm)
+                for (i, j), ani in zip(pairs, anis.tolist()):
+                    if not np.isnan(ani) and ani >= self.threshold:
+                        cache.insert((i, j), float(ani))
+        else:
+            results = fragment_ani.bidirectional_ani_batch(
+                [(profiles[i], profiles[j]) for i, j in pairs],
+                min_aligned_frac=self.min_aligned_fraction,
+                threads=self.store.threads)
+            for (i, j), (ani, _, _) in zip(pairs, results):
+                if ani is not None and ani >= self.threshold:
+                    cache.insert((i, j), ani)
         logger.info("Found %d pairs passing precluster threshold %.4f",
                     len(cache), self.threshold)
         return cache

@@ -411,7 +411,7 @@ def directed_ani_batch(
         key = (q.padded_windows().shape, r.padded_ref_set().shape[0])
         groups.setdefault(key, []).append(n)
 
-    n_dev = jax.device_count()
+    n_dev = len(jax.local_devices())  # host-local (see _shard_batch)
     for (wshape, _h), idxs in groups.items():
         per_query_elems = wshape[0] * wshape[1]
         b_max = max(1, _BATCH_ELEM_CAP // max(per_query_elems, 1))
@@ -456,17 +456,22 @@ def _shard_batch(pairs: "list[Tuple[GenomeProfile, GenomeProfile]]",
     The padded batch is assembled in host numpy and device_put straight
     into its sharded layout, so each device only ever holds its own
     shard — never the whole super-capacity batch.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from galah_tpu.parallel import make_mesh
+    The mesh is HOST-LOCAL (jax.local_devices()): on a multi-host
+    runtime each process batches its own (possibly host-divergent)
+    pair work — a global sharding would demand identical values on
+    every process, which the host-sharded exact-ANI split
+    (backends/fragment_backend._exact_ani_multihost) deliberately
+    violates. Single-process behavior is identical.
+    """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     b = len(pairs)
     b_pad = -(-b // n_dev) * n_dev
     padded = pairs + [pairs[0]] * (b_pad - b)
     wins_np = np.stack([q.padded_windows() for q, _ in padded])
     refs_np = np.stack([r.padded_ref_set() for _, r in padded])
-    mesh = make_mesh()
+    mesh = Mesh(np.array(jax.local_devices()), ("i",))
     wins = jax.device_put(wins_np, NamedSharding(mesh, P("i", None, None)))
     refs = jax.device_put(refs_np, NamedSharding(mesh, P("i", None)))
     return wins, refs

@@ -93,6 +93,17 @@ def main() -> int:
         clusters2 = cluster(paths, HLLPreclusterer(min_ani=0.9), cl)
         got2 = sorted(sorted(c) for c in clusters2)
         print(f"CLUSTERS_HLL {pid} {json.dumps(got2)}", flush=True)
+
+        # the DEFAULT combo (skani+skani): per-host marker profiling +
+        # host-sharded exact ANI with result exchange; skip_clusterer
+        # reuses the exchanged ANIs so the whole pipeline is split
+        from galah_tpu.backends import SkaniPreclusterer
+
+        pre3 = SkaniPreclusterer(threshold=0.9, min_aligned_fraction=0.2,
+                                 store=store)
+        clusters3 = cluster(paths, pre3, cl)
+        got3 = sorted(sorted(c) for c in clusters3)
+        print(f"CLUSTERS_SKANI {pid} {json.dumps(got3)}", flush=True)
     return 0
 
 

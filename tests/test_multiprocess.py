@@ -139,11 +139,15 @@ def test_two_process_end_to_end_cluster(tmp_path):
 
     comps = {}
     comps_hll = {}
+    comps_skani = {}
     for out in outs:
         for line in out.splitlines():
             if line.startswith("CLUSTERS_HLL"):
                 _, pid, comp = line.split(None, 2)
                 comps_hll[int(pid)] = json.loads(comp)
+            elif line.startswith("CLUSTERS_SKANI"):
+                _, pid, comp = line.split(None, 2)
+                comps_skani[int(pid)] = json.loads(comp)
             elif line.startswith("CLUSTERS"):
                 _, pid, comp = line.split(None, 2)
                 comps[int(pid)] = json.loads(comp)
@@ -151,3 +155,6 @@ def test_two_process_end_to_end_cluster(tmp_path):
     assert comps[0] == comps[1] == [[0, 1], [2, 3]], comps
     assert set(comps_hll) == {0, 1}, f"missing HLL output: {outs}"
     assert comps_hll[0] == comps_hll[1] == [[0, 1], [2, 3]], comps_hll
+    assert set(comps_skani) == {0, 1}, f"missing skani output: {outs}"
+    assert comps_skani[0] == comps_skani[1] == [[0, 1], [2, 3]], \
+        comps_skani
