@@ -322,34 +322,35 @@ class SkaniPreclusterer(PreclusterBackend):
 
     def _exact_ani_multihost(self, genome_paths, pairs, warm):
         """Exact ANI over the screened pairs, sharded by host: each
-        host evaluates pairs[rank::P], reusing phase A's `warm`
-        profiles for its own shard's genomes and profiling only the
-        cross-host endpoints (the shared disk cache makes those warm
-        too when enabled), then the per-pair ANIs are exchanged as one
-        float row matrix. Every host ends with the identical result
-        vector."""
+        host owns the pairs whose SECOND endpoint is in its phase-A
+        genome shard (owner j % P composes with host_shard's stride),
+        reuses `warm` profiles for those and profiles only cross-host
+        first endpoints (the shared disk cache makes them warm too
+        when enabled), then the per-pair ANIs are exchanged through
+        the shared protocol — which also propagates a host failure to
+        every peer instead of stranding them in the collective. Every
+        host ends with the identical result vector."""
         from galah_tpu.parallel import distributed
 
-        my_pairs = distributed.host_shard(pairs)
-        endpoints = list(dict.fromkeys(
-            g for pair in my_pairs for g in pair))
-        missing = [g for g in endpoints if g not in warm]
-        with timing.stage("profile-genomes"):
-            with self.store.reserve(max(len(missing), 1)):
-                prof = dict(zip(missing, self.store.get_many(
-                    [genome_paths[g] for g in missing])))
-        prof.update((g, warm[g]) for g in endpoints if g in warm)
-        results = fragment_ani.bidirectional_ani_batch(
-            [(prof[i], prof[j]) for i, j in my_pairs],
-            min_aligned_frac=self.min_aligned_fraction,
-            threads=self.store.threads)
-        local = np.full((len(my_pairs), 1), np.nan, dtype=np.float64)
-        for row_i, (ani, _, _) in enumerate(results):
-            if ani is not None:
-                local[row_i, 0] = ani
-        full = distributed.allgather_host_rows(
-            len(pairs), local, fill=np.nan)
-        return full[:, 0]
+        def compute_mine(idxs):
+            my_pairs = [pairs[k] for k in idxs]
+            endpoints = list(dict.fromkeys(
+                g for pair in my_pairs for g in pair))
+            missing = [g for g in endpoints if g not in warm]
+            with timing.stage("profile-genomes"):
+                with self.store.reserve(max(len(missing), 1)):
+                    prof = dict(zip(missing, self.store.get_many(
+                        [genome_paths[g] for g in missing])))
+            prof.update(
+                (g, warm[g]) for g in endpoints if g in warm)
+            results = fragment_ani.bidirectional_ani_batch(
+                [(prof[i], prof[j]) for i, j in my_pairs],
+                min_aligned_frac=self.min_aligned_fraction,
+                threads=self.store.threads)
+            return [ani for ani, _, _ in results]
+
+        return distributed.sharded_optional_floats(
+            len(pairs), compute_mine, owner=lambda k: pairs[k][1])
 
     def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
         from galah_tpu.parallel import distributed
@@ -386,8 +387,8 @@ class SkaniPreclusterer(PreclusterBackend):
             if pairs:
                 anis = self._exact_ani_multihost(genome_paths, pairs,
                                                  warm)
-                for (i, j), ani in zip(pairs, anis.tolist()):
-                    if not np.isnan(ani) and ani >= self.threshold:
+                for (i, j), ani in zip(pairs, anis):
+                    if ani is not None and ani >= self.threshold:
                         cache.insert((i, j), float(ani))
         else:
             results = fragment_ani.bidirectional_ani_batch(

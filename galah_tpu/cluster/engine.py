@@ -150,6 +150,38 @@ def cluster(
     return all_clusters
 
 
+def _backend_ani_batch(
+    clusterer: ClusterBackend,
+    path_pairs: List[Tuple[str, str]],
+) -> List[Optional[float]]:
+    """One backend ANI batch, host-split on multi-host runs.
+
+    Every process reaches this with the IDENTICAL pair list (the
+    engine is deterministic and its caches are identical across
+    hosts); the shared exchange (distributed.sharded_optional_floats)
+    splits it with pairs OWNED BY their second endpoint's path hash —
+    a genome's pairs against the (few, everywhere-profiled) reps land
+    on one host, so per-host profiling stays near unique/P instead of
+    every host touching every endpoint. A failing host propagates its
+    error to every peer instead of stranding them in the collective.
+    Single-process: a plain call.
+    """
+    from galah_tpu.parallel import distributed
+
+    n_proc = distributed.process_count()
+    if n_proc <= 1 or len(path_pairs) < n_proc:
+        return clusterer.calculate_ani_batch(path_pairs)
+
+    import zlib
+
+    owners = [zlib.crc32(b.encode()) for _a, b in path_pairs]
+    return distributed.sharded_optional_floats(
+        len(path_pairs),
+        lambda idxs: clusterer.calculate_ani_batch(
+            [path_pairs[k] for k in idxs]),
+        owner=lambda k: owners[k])
+
+
 def _batch_ani(
     clusterer: ClusterBackend,
     skip_clusterer: bool,
@@ -180,7 +212,8 @@ def _batch_ani(
             if computed_log is not None:
                 computed_log.append(pairs[n])
     if to_compute:
-        anis = clusterer.calculate_ani_batch([p for _, p in to_compute])
+        anis = _backend_ani_batch(clusterer,
+                                  [p for _, p in to_compute])
         for (n, _), ani in zip(to_compute, anis):
             out[n] = ani
     return out
@@ -195,7 +228,8 @@ def _warm_all_hit_pairs(
     keys = sorted(pre_cache.keys())
     warm = PairDistanceCache()
     if keys:
-        anis = clusterer.calculate_ani_batch(
+        anis = _backend_ani_batch(
+            clusterer,
             [(genomes[i], genomes[j]) for i, j in keys])
         for key, ani in zip(keys, anis):
             warm.insert(key, ani)

@@ -114,6 +114,73 @@ def allgather_host_rows(n_unique: int, local_rows: "np.ndarray",
     return out
 
 
+def sharded_optional_floats(n_total: int, compute_mine,
+                            owner=None) -> "List[Optional[float]]":
+    """Distribute `n_total` Optional[float] computations across hosts.
+
+    Every process calls this with the same n_total (a collective).
+    `compute_mine(indices)` returns this host's values for its shard;
+    `owner(k) -> int` assigns item k to a process (default: stride
+    `k % P`) — callers pick owners so a shard shares expensive context
+    (e.g. pair endpoints whose profiles the host already holds). The
+    exchange carries explicit indices, so any deterministic ownership
+    works. A host whose compute raises reports failure through the
+    exchange and EVERY host re-raises — a lone crash never strands the
+    peers inside the collective. None rides as NaN (producers never
+    emit NaN values).
+    """
+    from jax.experimental import multihost_utils
+
+    n_proc = process_count()
+    if n_proc <= 1:
+        return compute_mine(list(range(n_total)))
+    rank = process_index()
+    if owner is None:
+        mine = list(range(rank, n_total, n_proc))
+    else:
+        mine = [k for k in range(n_total) if owner(k) % n_proc == rank]
+
+    err: "Exception | None" = None
+    vals: "List[Optional[float]]" = []
+    try:
+        vals = list(compute_mine(mine))
+        if len(vals) != len(mine):
+            raise RuntimeError(
+                f"compute_mine returned {len(vals)} values for "
+                f"{len(mine)} indices")
+    except Exception as e:  # noqa: BLE001 - re-raised after exchange
+        err = e
+
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.array([len(mine)], dtype=np.int64), tiled=False))
+    per = max(int(sizes.max()), 1)
+    local = np.full((per, 2), np.nan, dtype=np.float64)
+    local[:, 0] = -1.0  # "no item here"
+    if err is None:
+        for r, (k, v) in enumerate(zip(mine, vals)):
+            local[r, 0] = float(k)
+            if v is not None:
+                local[r, 1] = v
+    status = np.array([1 if err is not None else 0], dtype=np.int64)
+    statuses = np.asarray(multihost_utils.process_allgather(
+        status, tiled=False))
+    gathered = np.asarray(multihost_utils.process_allgather(
+        local, tiled=False))
+    if int(statuses.sum()):
+        if err is not None:
+            raise err
+        raise RuntimeError(
+            "a peer process failed its shard of a distributed ANI "
+            "batch; see that process's log for the original error")
+    out: "List[Optional[float]]" = [None] * n_total
+    for p in range(n_proc):
+        for row in gathered[p]:
+            k = int(row[0])
+            if k >= 0:
+                out[k] = None if np.isnan(row[1]) else float(row[1])
+    return out
+
+
 def tokens_agree(token: bytes) -> bool:
     """True iff every process passed the identical token (fixed-length
     digest; callers hash variable-size state first). Used to make
