@@ -150,28 +150,19 @@ def sharded_optional_floats(n_total: int, compute_mine,
                 f"{len(mine)} indices")
     except Exception as e:  # noqa: BLE001 - re-raised after exchange
         err = e
+    raise_if_any_host_failed(err)
 
     sizes = np.asarray(multihost_utils.process_allgather(
         np.array([len(mine)], dtype=np.int64), tiled=False))
     per = max(int(sizes.max()), 1)
     local = np.full((per, 2), np.nan, dtype=np.float64)
     local[:, 0] = -1.0  # "no item here"
-    if err is None:
-        for r, (k, v) in enumerate(zip(mine, vals)):
-            local[r, 0] = float(k)
-            if v is not None:
-                local[r, 1] = v
-    status = np.array([1 if err is not None else 0], dtype=np.int64)
-    statuses = np.asarray(multihost_utils.process_allgather(
-        status, tiled=False))
+    for r, (k, v) in enumerate(zip(mine, vals)):
+        local[r, 0] = float(k)
+        if v is not None:
+            local[r, 1] = v
     gathered = np.asarray(multihost_utils.process_allgather(
         local, tiled=False))
-    if int(statuses.sum()):
-        if err is not None:
-            raise err
-        raise RuntimeError(
-            "a peer process failed its shard of a distributed ANI "
-            "batch; see that process's log for the original error")
     out: "List[Optional[float]]" = [None] * n_total
     for p in range(n_proc):
         for row in gathered[p]:
@@ -179,6 +170,29 @@ def sharded_optional_floats(n_total: int, compute_mine,
             if k >= 0:
                 out[k] = None if np.isnan(row[1]) else float(row[1])
     return out
+
+
+def raise_if_any_host_failed(err: "Exception | None") -> None:
+    """Collective status exchange before a data collective: every
+    process reports whether its local compute failed; if any did, ALL
+    raise (the failing host its own error, peers a pointer to it) —
+    a lone crash must never strand the other hosts inside the data
+    exchange. Callers must reach this on every process."""
+    if process_count() <= 1:
+        if err is not None:
+            raise err
+        return
+    from jax.experimental import multihost_utils
+
+    status = np.array([1 if err is not None else 0], dtype=np.int64)
+    statuses = np.asarray(multihost_utils.process_allgather(
+        status, tiled=False))
+    if err is not None:
+        raise err
+    if int(statuses.sum()):
+        raise RuntimeError(
+            "a peer process failed its shard of a distributed pass; "
+            "see that process's log for the original error")
 
 
 def tokens_agree(token: bytes) -> bool:

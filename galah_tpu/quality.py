@@ -168,12 +168,48 @@ def filter_and_order_genomes(
             continue
         kept.append(p)
 
-    stats_cache: Dict[str, GenomeStats] = {}
-    if formula in ("Parks2020_reduced", "dRep") and threads > 1 and kept:
-        from concurrent.futures import ThreadPoolExecutor
+    def map_stats(paths: Sequence[str]) -> List[GenomeStats]:
+        if threads > 1 and len(paths) > 1:
+            from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=threads) as pool:
-            for p, s in zip(kept, pool.map(stats_fn, kept)):
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                return list(pool.map(stats_fn, paths))
+        return [stats_fn(p) for p in paths]
+
+    stats_cache: Dict[str, GenomeStats] = {}
+    needs_stats = formula in ("Parks2020_reduced", "dRep")
+    if needs_stats and kept:
+        from galah_tpu.parallel import distributed
+
+        if distributed.process_count() > 1:
+            # Host-split the stats pass (it reads every FASTA): each
+            # host stats its strided shard, the 3-int rows are
+            # exchanged, and every host ranks identically. A failing
+            # host propagates through the status exchange instead of
+            # stranding its peers inside the allgather.
+            import numpy as np
+
+            mine = distributed.host_shard(kept)
+            err = None
+            local_stats: List[GenomeStats] = []
+            try:
+                local_stats = map_stats(mine)
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                err = e
+            distributed.raise_if_any_host_failed(err)
+            local = np.array(
+                [[s.num_contigs, s.num_ambiguous_bases, s.n50]
+                 for s in local_stats],
+                dtype=np.int64).reshape(len(mine), 3)
+            full = distributed.allgather_host_rows(
+                len(kept), local, fill=np.int64(0))
+            for i, p in enumerate(kept):
+                stats_cache[p] = GenomeStats(
+                    num_contigs=int(full[i, 0]),
+                    num_ambiguous_bases=int(full[i, 1]),
+                    n50=int(full[i, 2]))
+        elif threads > 1:
+            for p, s in zip(kept, map_stats(kept)):
                 stats_cache[p] = s
 
     def get_stats(p: str) -> GenomeStats:

@@ -104,6 +104,22 @@ def main() -> int:
         clusters3 = cluster(paths, pre3, cl)
         got3 = sorted(sorted(c) for c in clusters3)
         print(f"CLUSTERS_SKANI {pid} {json.dumps(got3)}", flush=True)
+
+        # quality ranking with the host-split stats pass: every host
+        # must produce the identical order
+        info = os.path.join(sys.argv[4], "info.csv")
+        if os.path.exists(info):
+            from galah_tpu.quality import (
+                filter_and_order_genomes,
+                read_genome_info_file,
+            )
+
+            table = read_genome_info_file(info)
+            ordered = filter_and_order_genomes(
+                paths, table, formula="Parks2020_reduced")
+            print(f"ORDER {pid} "
+                  f"{json.dumps([os.path.basename(p) for p in ordered])}",
+                  flush=True)
     return 0
 
 

@@ -94,8 +94,13 @@ def _write_family_genomes(root):
                     codes[sites]
                     + rng.integers(1, 4, size=int(sites.sum()))) % 4
             p = os.path.join(root, f"fam{fam}_m{member}.fna")
+            seq = "".join(bases[codes])
             with open(p, "w") as f:
-                f.write(">c1\n" + "".join(bases[codes]) + "\n")
+                if member:  # 2 contigs: the stats-decisive quality tie
+                    f.write(">c1\n" + seq[:3000] + "\n"
+                            ">c2\n" + seq[3000:] + "\n")
+                else:
+                    f.write(">c1\n" + seq + "\n")
             paths.append(p)
     return paths
 
@@ -109,7 +114,16 @@ def test_two_process_end_to_end_cluster(tmp_path):
 
     gdir = str(tmp_path / "genomes")
     os.makedirs(gdir)
-    _write_family_genomes(gdir)
+    paths = _write_family_genomes(gdir)
+    # IDENTICAL quality for every genome: the ranking below must be
+    # decided by the exchanged assembly stats alone (member-1 genomes
+    # are written as two contigs; a broken stats exchange would leave
+    # the order at input order and trip the assertion)
+    with open(os.path.join(gdir, "info.csv"), "w") as f:
+        f.write("genome,completeness,contamination\n")
+        for p in paths:
+            stem = os.path.splitext(os.path.basename(p))[0]
+            f.write(f"{stem},90,1\n")
 
     coord = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items()
@@ -158,3 +172,15 @@ def test_two_process_end_to_end_cluster(tmp_path):
     assert set(comps_skani) == {0, 1}, f"missing skani output: {outs}"
     assert comps_skani[0] == comps_skani[1] == [[0, 1], [2, 3]], \
         comps_skani
+    orders = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("ORDER"):
+                _, pid, order = line.split(None, 2)
+                orders[int(pid)] = json.loads(order)
+    assert set(orders) == {0, 1}, f"missing order output: {outs}"
+    # identical completeness/contamination: the exchanged contig
+    # counts decide (1-contig m0 genomes outrank 2-contig m1; ties
+    # keep input order)
+    assert orders[0] == orders[1] == [
+        "fam0_m0.fna", "fam1_m0.fna", "fam0_m1.fna", "fam1_m1.fna"]
