@@ -105,6 +105,20 @@ def main() -> int:
         got3 = sorted(sorted(c) for c in clusters3)
         print(f"CLUSTERS_SKANI {pid} {json.dumps(got3)}", flush=True)
 
+        # failure symmetry: one host fails its shard of a distributed
+        # pass; EVERY process must raise (nobody strands in the
+        # collective)
+        def _compute(idxs):
+            if pid == 1:
+                raise RuntimeError("planted shard failure")
+            return [1.0] * len(idxs)
+
+        try:
+            distributed.sharded_optional_floats(8, _compute)
+            print(f"FAILTEST {pid} NORAISE", flush=True)
+        except Exception:
+            print(f"FAILTEST {pid} RAISED", flush=True)
+
         # quality ranking with the host-split stats pass: every host
         # must produce the identical order
         info = os.path.join(sys.argv[4], "info.csv")

@@ -172,6 +172,14 @@ def test_two_process_end_to_end_cluster(tmp_path):
     assert set(comps_skani) == {0, 1}, f"missing skani output: {outs}"
     assert comps_skani[0] == comps_skani[1] == [[0, 1], [2, 3]], \
         comps_skani
+    fails = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("FAILTEST"):
+                _, pid, verdict = line.split()
+                fails[int(pid)] = verdict
+    assert fails == {0: "RAISED", 1: "RAISED"}, (
+        f"failure must propagate to every host: {fails or outs}")
     orders = {}
     for out in outs:
         for line in out.splitlines():

@@ -147,3 +147,26 @@ def test_screen_pairs_sparse_on_any_backend(monkeypatch):
     dense = _screen_pairs_single(mat, counts, 0.8, 64, 128, 256, False)
     assert sorted(sparse) == sorted(dense)
     assert len(sparse) > 0
+
+
+def test_sparse_device_equals_c_kernel_at_scale():
+    """Cross-implementation equivalence on a large family matrix: the
+    screened device pipeline (collision screen + gathered XLA pair
+    stats) and the compiled-C merged walk (its own screen + C walk)
+    must produce the identical pair dict — two independent
+    implementations of the same contract."""
+    cps = pytest.importorskip("galah_tpu.ops._cpairstats")
+
+    mat = _family_sketches(n=5000, width=64, n_fam=250, seed=101,
+                           mutations=30)
+    via_device = threshold_pairs_sparse(mat, k=21, min_ani=0.95,
+                                        sketch_size=mat.shape[1])
+    via_c = cps.threshold_pairs_c(mat, mat.shape[1], 21, 0.95)
+    # identical pair SETS exactly (the keep-check is rational f64 on
+    # both sides); ANI values via approx — np.log and libm log are
+    # independent transcendental implementations (repo precedent:
+    # tests/test_cpairstats.py)
+    assert set(via_device) == set(via_c)
+    for key, v in via_device.items():
+        assert via_c[key] == pytest.approx(v, abs=1e-12), key
+    assert len(via_c) > 1000
