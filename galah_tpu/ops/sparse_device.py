@@ -48,18 +48,34 @@ from galah_tpu.ops.pairwise import (
 PAIR_BATCH = 8192
 
 
-@functools.partial(jax.jit, static_argnames=("sketch_size",))
+@functools.partial(
+    jax.jit,
+    static_argnames=("sketch_size", "use_pallas", "interpret"))
 def _batch_pair_stats(jmat: jax.Array, pi: jax.Array, pj: jax.Array,
-                      sketch_size: int) -> Tuple[jax.Array, jax.Array]:
-    """(common, total) int32 for each gathered (pi[b], pj[b]) row pair."""
+                      sketch_size: int,
+                      use_pallas: bool = False,
+                      interpret: bool = False,
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """(common, total) int32 for each gathered (pi[b], pj[b]) row pair.
+
+    With use_pallas (the default on a TPU backend) the gathered pairs
+    run the Mosaic pairlist kernel (ops/pallas_pairlist.py) instead of
+    the vmapped u64 searchsorted — bit-identical integers either way.
+    """
     rows = jnp.take(jmat, pi, axis=0)
     cols = jnp.take(jmat, pj, axis=0)
+    if use_pallas:
+        from galah_tpu.ops.pallas_pairlist import pair_stats_pairs_pallas
+
+        return pair_stats_pairs_pallas(rows, cols, sketch_size,
+                                       interpret=interpret)
     return jax.vmap(
         lambda a, b: _pair_stats(a, b, sketch_size))(rows, cols)
 
 
 @functools.lru_cache(maxsize=8)
-def _make_sharded_batch_stats(mesh: Mesh, sketch_size: int):
+def _make_sharded_batch_stats(mesh: Mesh, sketch_size: int,
+                              use_pallas: bool = False):
     """SPMD twin: the candidate batch is sharded over the mesh axis,
     the sketch matrix is replicated; each device evaluates its slice
     of the pair list. The per-pair outputs are all-gathered back to a
@@ -68,7 +84,8 @@ def _make_sharded_batch_stats(mesh: Mesh, sketch_size: int):
     host."""
 
     def spmd(jmat, pi, pj):
-        c, t = _batch_pair_stats(jmat, pi, pj, sketch_size)
+        c, t = _batch_pair_stats(jmat, pi, pj, sketch_size,
+                                 use_pallas=use_pallas)
         return (jax.lax.all_gather(c, "i", tiled=True),
                 jax.lax.all_gather(t, "i", tiled=True))
 
@@ -90,13 +107,17 @@ def pair_stats_for_pairs(
     sketch_size: int,
     mesh: Optional[Mesh] = None,
     batch: int = PAIR_BATCH,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Exact merged-bottom-k (common, total) for an explicit pair list.
 
     One device dispatch per `batch` candidates (fixed shape, so the
     trace compiles once); the final partial batch is padded with pair
     (0, 0) and trimmed on host. With a multi-device `mesh` the batch is
-    sharded over the mesh axis.
+    sharded over the mesh axis. use_pallas selects the Mosaic pairlist
+    kernel (default: on for TPU backends, with XLA fallback on a
+    lowering failure — explicit True pins it, failures propagate).
     """
     n_pairs = int(pi.shape[0])
     common = np.empty(n_pairs, dtype=np.int32)
@@ -104,14 +125,25 @@ def pair_stats_for_pairs(
     if n_pairs == 0:
         return common, total
 
+    explicit = use_pallas is not None
+    if use_pallas is None:
+        from galah_tpu.ops.hll import use_pallas_default
+
+        use_pallas = use_pallas_default()
+
     jmat = jnp.asarray(np.ascontiguousarray(sketch_mat, dtype=np.uint64))
     n_dev = mesh.devices.size if mesh is not None else 1
     b = -(-batch // n_dev) * n_dev
-    if mesh is not None and n_dev > 1:
-        fn = _make_sharded_batch_stats(mesh, sketch_size)
-    else:
-        fn = functools.partial(_batch_pair_stats,
-                               sketch_size=sketch_size)
+
+    def make_fn(pallas: bool):
+        if mesh is not None and n_dev > 1:
+            return _make_sharded_batch_stats(mesh, sketch_size, pallas)
+        return functools.partial(_batch_pair_stats,
+                                 sketch_size=sketch_size,
+                                 use_pallas=pallas,
+                                 interpret=interpret)
+
+    fn = make_fn(bool(use_pallas))
 
     pi32 = np.ascontiguousarray(pi, dtype=np.int32)
     pj32 = np.ascontiguousarray(pj, dtype=np.int32)
@@ -121,7 +153,21 @@ def pair_stats_for_pairs(
         bj = np.zeros(b, dtype=np.int32)
         bi[: e - s] = pi32[s:e]
         bj[: e - s] = pj32[s:e]
-        c, t = fn(jmat, jnp.asarray(bi), jnp.asarray(bj))
+        try:
+            c, t = fn(jmat, jnp.asarray(bi), jnp.asarray(bj))
+        except Exception:
+            if explicit or not use_pallas:
+                raise
+            # Mosaic lowering failure must not take down the sparse
+            # production path: fall back to XLA for the whole run.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "Pallas pairlist kernel unavailable; falling back to "
+                "the XLA searchsorted path", exc_info=True)
+            use_pallas = False
+            fn = make_fn(False)
+            c, t = fn(jmat, jnp.asarray(bi), jnp.asarray(bj))
         common[s:e] = np.asarray(c)[: e - s]
         total[s:e] = np.asarray(t)[: e - s]
     return common, total

@@ -13,6 +13,20 @@ import numpy as np
 sys.path.insert(0, "/root/repo")
 
 
+def _measure(fn, repeats=3):
+    """Warm/compile, then best-of-repeats with a drift check: the
+    summed stats must not change between timed calls (forces host
+    materialization too — the tunnel's block_until_ready is async)."""
+    ref = int(np.asarray(fn()[0]).sum())
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        got = int(np.asarray(fn()[0]).sum())
+        best = min(best, time.perf_counter() - t0)
+        assert got == ref
+    return best
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -37,16 +51,33 @@ def main():
             ("pallas+skip",
              lambda: tile_stats_pallas(r, c, K, range_skip=True)),
         ):
-            out = fn()
-            ref = int(np.asarray(out[0]).sum())  # compile + warm
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                got = int(np.asarray(fn()[0]).sum())
-                best = min(best, time.perf_counter() - t0)
-            assert got == ref
+            best = _measure(fn)
             print(f"{label} {n}x{n}: {best*1e3:.1f} ms = "
                   f"{n*n/best:,.0f} pairs/s", flush=True)
+
+    # Pairlist kernel (the sparse pipeline's exact pass) vs the
+    # vmapped XLA searchsorted on the same gathered pair batch.
+    from galah_tpu.ops.pairwise import _pair_stats
+    from galah_tpu.ops.pallas_pairlist import pair_stats_pairs_pallas
+
+    m = rng.integers(0, 1 << 63, size=(1024, K), dtype=np.uint64)
+    m.sort(axis=1)
+    b = 8192
+    pa = jnp.asarray(m[rng.integers(0, 1024, size=b)])
+    pb = jnp.asarray(m[rng.integers(0, 1024, size=b)])
+
+    @jax.jit
+    def xla_pairs(a, bb):
+        return jax.vmap(lambda x, y: _pair_stats(x, y, K))(a, bb)
+
+    for label, fn in (
+        ("pairlist-xla", lambda: xla_pairs(pa, pb)),
+        ("pairlist-mosaic",
+         lambda: pair_stats_pairs_pallas(pa, pb, K)),
+    ):
+        best = _measure(fn)
+        print(f"{label} B={b}: {best*1e3:.1f} ms = "
+              f"{b/best:,.0f} pairs/s", flush=True)
 
 
 if __name__ == "__main__":

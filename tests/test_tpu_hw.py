@@ -62,6 +62,18 @@ ps_x, z_x = hll._xla_union_stats(pr, pr)
 assert np.allclose(np.asarray(ps_p), np.asarray(ps_x), rtol=1e-5)
 assert np.array_equal(np.asarray(z_p), np.asarray(z_x))
 
+# Mosaic pairlist kernel (ops/pallas_pairlist.py) lowers and matches
+# the vmapped XLA pair stats bit-for-bit on gathered pairs
+from galah_tpu.ops.pairwise import _pair_stats
+from galah_tpu.ops.pallas_pairlist import pair_stats_pairs_pallas
+pr = rng.integers(0, 64, size=200)
+pc = rng.integers(0, 64, size=200)
+pa, pb = jnp.asarray(mat[pr]), jnp.asarray(mat[pc])
+gc_, gt_ = pair_stats_pairs_pallas(pa, pb, K)
+wc_, wt_ = jax.vmap(lambda a, b: _pair_stats(a, b, K))(pa, pb)
+assert np.array_equal(np.asarray(gc_), np.asarray(wc_)), "pairlist common"
+assert np.array_equal(np.asarray(gt_), np.asarray(wt_)), "pairlist total"
+
 # Mosaic murmur3 state machine (ops/pallas_sketch.py) lowers and
 # matches the XLA u64-emulated hash core bit-for-bit
 from galah_tpu.ops.hashing import _murmur3_k21_1d
