@@ -1,0 +1,43 @@
+"""Shared explicit-pin / default-fallback policy for Mosaic kernels.
+
+Every device extraction offers a Mosaic kernel with an XLA twin. The
+policy, identical at every call site: when the caller pinned the path
+(explicit use_pallas=True/False) failures propagate loudly — parity
+tests must never vacuously compare XLA to XLA; when pallas was chosen
+by default (use_pallas=None resolved via use_pallas_default), a Mosaic
+lowering failure (driver/toolchain drift) must never take down the
+production path — warn once with the traceback and rerun via XLA.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Tuple, TypeVar
+
+T = TypeVar("T")
+
+log = logging.getLogger(__name__)
+
+
+def run_with_pallas_fallback(
+    kernel_label: str,
+    explicit: bool,
+    use_pallas: bool,
+    run: Callable[[bool], T],
+    fallback_label: str = "the XLA searchsorted path",
+) -> Tuple[T, bool]:
+    """Run `run(pallas)` under the shared fallback policy.
+
+    Returns (result, pallas_used) so loops that dispatch many batches
+    can downgrade once and skip the retry for the rest of the run.
+    """
+    if use_pallas:
+        try:
+            return run(True), True
+        except Exception:
+            if explicit:
+                raise
+            log.warning(
+                "Pallas %s unavailable; falling back to %s",
+                kernel_label, fallback_label, exc_info=True)
+    return run(False), False

@@ -304,28 +304,19 @@ def hll_threshold_pairs(
     explicit = use_pallas is not None
     if use_pallas is None:
         use_pallas = use_pallas_default()
-    if use_pallas:
-        try:
-            # The Mosaic kernel is compiled/validated at the 128x128
-            # output tile geometry (square tiles keep the out block at
-            # the native (8,128)-register multiple); other shapes have
-            # hit remote-compile hangs on v5e.
-            return _hll_threshold_single(
-                regs_mat, k, min_ani, 128, 128, True, cap_per_row)
-        except Exception:
-            if explicit:
-                # an explicitly requested kernel fails loudly so parity
-                # tests can't vacuously compare XLA to XLA
-                raise
-            # A Mosaic lowering failure must never take down the
-            # default path (same fallback as threshold_pairs).
-            import logging
+    from galah_tpu.ops._fallback import run_with_pallas_fallback
 
-            logging.getLogger(__name__).warning(
-                "Pallas HLL kernel unavailable; falling back to the "
-                "XLA union-stats path", exc_info=True)
-    return _hll_threshold_single(
-        regs_mat, k, min_ani, row_tile, col_tile, False, cap_per_row)
+    # The Mosaic kernel is compiled/validated at the 128x128 output
+    # tile geometry (square tiles keep the out block at the native
+    # (8,128)-register multiple); other shapes have hit remote-compile
+    # hangs on v5e.
+    result, _ = run_with_pallas_fallback(
+        "HLL kernel", explicit, bool(use_pallas),
+        lambda p: _hll_threshold_single(
+            regs_mat, k, min_ani, 128 if p else row_tile,
+            128 if p else col_tile, p, cap_per_row),
+        fallback_label="the XLA union-stats path")
+    return result
 
 
 def _hll_threshold_single(

@@ -393,26 +393,16 @@ def screen_pairs(
 
         use_pallas = use_pallas_default()
     # per-path tile defaults, honoring explicit caller values
-    if use_pallas:
-        try:
-            return _screen_pairs_single(
-                marker_mat, counts, c_floor,
-                row_tile if row_tile is not None else 128,
-                col_tile if col_tile is not None else 256,
-                cap_per_row, True)
-        except Exception:
-            if explicit:
-                raise
-            import logging
+    from galah_tpu.ops._fallback import run_with_pallas_fallback
 
-            logging.getLogger(__name__).warning(
-                "Pallas intersect kernel unavailable; falling back to "
-                "the XLA searchsorted path", exc_info=True)
-    return _screen_pairs_single(
-        marker_mat, counts, c_floor,
-        row_tile if row_tile is not None else 64,
-        col_tile if col_tile is not None else 256, cap_per_row,
-        False)
+    result, _ = run_with_pallas_fallback(
+        "intersect kernel", explicit, bool(use_pallas),
+        lambda p: _screen_pairs_single(
+            marker_mat, counts, c_floor,
+            row_tile if row_tile is not None else (128 if p else 64),
+            col_tile if col_tile is not None else 256,
+            cap_per_row, p))
+    return result
 
 
 def _screen_pairs_single(
@@ -575,25 +565,16 @@ def threshold_pairs(
 
     if sketch_size is None:
         sketch_size = sketch_mat.shape[1]
-    try:
-        return _threshold_pairs_single(
-            sketch_mat, k, min_ani, sketch_size, rt, ct,
-            bool(use_pallas), cap_per_row)
-    except Exception:
-        if not use_pallas or explicit:
-            raise
-        # The Mosaic kernel failing to lower (driver/toolchain drift)
-        # must never take down the default path: fall back to XLA.
-        import logging
+    from galah_tpu.ops._fallback import run_with_pallas_fallback
 
-        logging.getLogger(__name__).warning(
-            "Pallas pair-stats kernel unavailable; falling back to the "
-            "XLA searchsorted path", exc_info=True)
-        return _threshold_pairs_single(
+    result, _ = run_with_pallas_fallback(
+        "pair-stats kernel", explicit, bool(use_pallas),
+        lambda p: _threshold_pairs_single(
             sketch_mat, k, min_ani, sketch_size,
-            row_tile if row_tile is not None else 64,
-            col_tile if col_tile is not None else 128, False,
-            cap_per_row)
+            rt if p else (row_tile if row_tile is not None else 64),
+            ct if p else (col_tile if col_tile is not None else 128),
+            p, cap_per_row))
+    return result
 
 
 def _threshold_pairs_single(

@@ -6,26 +6,33 @@ one result per pair, not a (rows x cols) tile. The XLA formulation is
 a vmapped u64 searchsorted (gather-heavy and 64-bit-emulated — the
 same costs that motivated ops/pallas_pairwise.py, which measured ~26x
 over the XLA path on chip). This kernel recomputes the identical
-integers from dense block compares on u32 hi/lo planes, per pair:
+integers from dense block compares on u32 hi/lo planes.
 
-Layouts (legal under Mosaic's (8, 128) tiling; dynamic indexing on
-sublanes only):
+Design note (hardware-driven): the first cut of this kernel walked 64
+pairs per grid program with `pl.ds(q, 1)` row loads; Mosaic rejects
+that on real v5e hardware ("dynamic load with unaligned indices" —
+dynamic sublane offsets must be 8-aligned). This version has NO
+dynamic indexing at all: the grid is one program per pair and the
+BlockSpec index maps select each pair's rows — block windowing is a
+DMA copy, which takes arbitrary row offsets. Layouts:
 
   * a side: (B*8, la) planes, la = K_pad/8 — pair p's value k = l*8+s
-    at row p*8 + s, lane l (the dense kernel's query layout);
-  * b side: (B, K_pad) planes — pair p's full sorted row on lanes
-    (K_pad a multiple of 128);
-  * out: (G*8, 128) int32 blocks, G = B_pad/PAIRS_PER_PROGRAM —
-    program g writes pair q's (common, total) at row 8g, lane q via
-    one-hot accumulation (no dynamic lane stores on TPU).
+    at row p*8 + s, lane l (the dense kernel's query layout); block
+    (8, la) at block-row p;
+  * b side: (B*sb, 128) planes, sb = K_pad/128 — pair p's sorted row
+    chunk s on row p*sb + s; block (sb, 128) at block-row p, so chunk
+    s is the block's STATIC row s (K_pad is padded to a multiple of
+    1024 = 8*128 so sb satisfies the sublane-divisibility rule);
+  * out: (B*8, 128) int32, block (8, 128) at block-row p; the pair's
+    (common, total) is broadcast across the block and read back at
+    (row 0, lane 0).
 
-One grid program walks PAIRS_PER_PROGRAM pairs with a fori loop
-(dynamic sublane slices select pair q's a group and b row); per pair,
-static loops over a lanes x b chunks accumulate #(b < a_i) and
-#(b == a_i) from (8, 1) x (1, 128) broadcast compares — (8, 128) is
-one native vreg, so the VPU stays full. The union-rank epilogue is the
-dense kernel's, on (8, la) planes. Bit-identical integers to
-ops/pairwise._pair_stats (tests/test_pallas_pairlist.py).
+Per program, static loops over a lanes x b chunks accumulate
+#(b < a_i) and #(b == a_i) from (8, 1) x (1, 128) broadcast compares —
+(8, 128) is one native vreg, so the VPU stays full. The union-rank
+epilogue is the dense kernel's, on (8, la) planes. Bit-identical
+integers to ops/pairwise._pair_stats (tests/test_pallas_pairlist.py;
+hardware lowering pinned by tests/test_tpu_hw.py).
 """
 
 from __future__ import annotations
@@ -48,76 +55,67 @@ from galah_tpu.ops.pallas_pairwise import (
 
 A_SUB = 8
 B_LANE = 128
-PAIRS_PER_PROGRAM = 64
 
 
 def _make_kernel(la: int, sb: int, sketch_size: int):
-    """Kernel for K_pad = 8*la = 128*sb; one program = 64 pairs."""
-    pp = PAIRS_PER_PROGRAM
+    """Kernel for K_pad = 8*la = 128*sb; one program = one pair."""
 
     def kernel(a_hi_ref, a_lo_ref, b_hi_ref, b_lo_ref,
                common_ref, total_ref):
         umax = jnp.uint32(0xFFFFFFFF)
-        lane = jax.lax.broadcasted_iota(jnp.int32, (A_SUB, B_LANE), 1)
-        subl = jax.lax.broadcasted_iota(jnp.int32, (A_SUB, B_LANE), 0)
 
-        def q_body(q, carry):
-            crows, trows = carry    # (8, 128) accumulators, row 0 live
-            ah = a_hi_ref[pl.ds(q * A_SUB, A_SUB), :]   # (8, la)
-            al = a_lo_ref[pl.ds(q * A_SUB, A_SUB), :]
-            valid_a = ~((ah == umax) & (al == umax))
-            na = _ssum_i32(valid_a)
+        ah = a_hi_ref[:, :]   # (8, la)
+        al = a_lo_ref[:, :]
+        valid_a = ~((ah == umax) & (al == umax))
+        na = _ssum_i32(valid_a)
 
-            nb = jnp.int32(0)
-            lt_cols = []
-            eq_cols = []
-            for l in range(la):
-                a_h = ah[:, l:l + 1]   # (8, 1)
-                a_l = al[:, l:l + 1]
-                ltacc = jnp.zeros((A_SUB, B_LANE), jnp.int32)
-                eqacc = jnp.zeros((A_SUB, B_LANE), jnp.int32)
-                for s in range(sb):
-                    bh = b_hi_ref[pl.ds(q, 1),
-                                  s * B_LANE:(s + 1) * B_LANE]  # (1,128)
-                    bl = b_lo_ref[pl.ds(q, 1),
-                                  s * B_LANE:(s + 1) * B_LANE]
-                    if l == 0:
-                        nb = nb + _ssum_i32(~((bh == umax) & (bl == umax)))
-                    eq = (bh == a_h) & (bl == a_l)
-                    eqacc = eqacc + eq.astype(jnp.int32)
-                    lt = (bh < a_h) | ((bh == a_h) & (bl < a_l))
-                    ltacc = ltacc + lt.astype(jnp.int32)
-                lt_cols.append(jnp.sum(ltacc, axis=1, keepdims=True,
-                                       dtype=jnp.int32))
-                eq_cols.append(jnp.sum(eqacc, axis=1, keepdims=True,
-                                       dtype=jnp.int32))
-            ltv = jnp.concatenate(lt_cols, axis=1)   # (8, la)
-            eqv = jnp.concatenate(eq_cols, axis=1)
+        # The pair's b row, materialized once as (1, 128) lane chunks:
+        # chunk s is static row s of the (sb, 128) block.
+        bh_chunks = [b_hi_ref[s:s + 1, :] for s in range(sb)]
+        bl_chunks = [b_lo_ref[s:s + 1, :] for s in range(sb)]
+        nb = jnp.int32(0)
+        for s in range(sb):
+            nb = nb + _ssum_i32(
+                ~((bh_chunks[s] == umax) & (bl_chunks[s] == umax)))
 
-            match = ((eqv > 0) & valid_a).astype(jnp.int32)
-            n_common_all = _ssum_i32(match)
-            n_union = na + nb - n_common_all
-            total = jnp.minimum(jnp.int32(sketch_size), n_union)
+        lt_cols = []
+        eq_cols = []
+        for l in range(la):
+            a_h = ah[:, l:l + 1]   # (8, 1)
+            a_l = al[:, l:l + 1]
+            ltacc = jnp.zeros((A_SUB, B_LANE), jnp.int32)
+            eqacc = jnp.zeros((A_SUB, B_LANE), jnp.int32)
+            for s in range(sb):
+                bh = bh_chunks[s]
+                bl = bl_chunks[s]
+                eq = (bh == a_h) & (bl == a_l)
+                eqacc = eqacc + eq.astype(jnp.int32)
+                lt = (bh < a_h) | ((bh == a_h) & (bl < a_l))
+                ltacc = ltacc + lt.astype(jnp.int32)
+            lt_cols.append(jnp.sum(ltacc, axis=1, keepdims=True,
+                                   dtype=jnp.int32))
+            eq_cols.append(jnp.sum(eqacc, axis=1, keepdims=True,
+                                   dtype=jnp.int32))
+        ltv = jnp.concatenate(lt_cols, axis=1)   # (8, la)
+        eqv = jnp.concatenate(eq_cols, axis=1)
 
-            colsum = jnp.sum(match, axis=0, keepdims=True,
-                             dtype=jnp.int32)
-            col_excl = _inclusive_cumsum_axis1(colsum) - colsum
-            row_excl = _inclusive_cumsum_axis0(match) - match
-            cexcl = col_excl + row_excl
-            s_idx = jax.lax.broadcasted_iota(jnp.int32, (A_SUB, la), 0)
-            l_idx = jax.lax.broadcasted_iota(jnp.int32, (A_SUB, la), 1)
-            urank = l_idx * A_SUB + s_idx + ltv - cexcl
-            common = _ssum_i32(match * (urank < total).astype(jnp.int32))
+        match = ((eqv > 0) & valid_a).astype(jnp.int32)
+        n_common_all = _ssum_i32(match)
+        n_union = na + nb - n_common_all
+        total = jnp.minimum(jnp.int32(sketch_size), n_union)
 
-            hot = ((lane == q) & (subl == 0)).astype(jnp.int32)
-            return crows + hot * common, trows + hot * total
+        colsum = jnp.sum(match, axis=0, keepdims=True,
+                         dtype=jnp.int32)
+        col_excl = _inclusive_cumsum_axis1(colsum) - colsum
+        row_excl = _inclusive_cumsum_axis0(match) - match
+        cexcl = col_excl + row_excl
+        s_idx = jax.lax.broadcasted_iota(jnp.int32, (A_SUB, la), 0)
+        l_idx = jax.lax.broadcasted_iota(jnp.int32, (A_SUB, la), 1)
+        urank = l_idx * A_SUB + s_idx + ltv - cexcl
+        common = _ssum_i32(match * (urank < total).astype(jnp.int32))
 
-        crows, trows = jax.lax.fori_loop(
-            jnp.int32(0), jnp.int32(pp), q_body,
-            (jnp.zeros((A_SUB, B_LANE), jnp.int32),
-             jnp.zeros((A_SUB, B_LANE), jnp.int32)))
-        common_ref[:] = crows
-        total_ref[:] = trows
+        common_ref[:] = jnp.broadcast_to(common, (A_SUB, B_LANE))
+        total_ref[:] = jnp.broadcast_to(total, (A_SUB, B_LANE))
 
     return kernel
 
@@ -134,58 +132,55 @@ def pair_stats_pairs_pallas(
     — the Mosaic twin of the vmapped ops/pairwise._pair_stats used by
     the screened sparse pipeline. Bit-identical integers."""
     b_in, k_in = rows_a.shape
+    if b_in == 0:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z
     sent = ~jnp.uint64(0)
 
-    k_pad = -(-k_in // B_LANE) * B_LANE
+    # K_pad must be a multiple of 8*128 so the b-side (sb, 128) block
+    # satisfies Mosaic's sublane-divisibility rule (sb % 8 == 0).
+    k_pad = -(-k_in // (A_SUB * B_LANE)) * (A_SUB * B_LANE)
     if k_pad != k_in:
         fill = jnp.full((b_in, k_pad - k_in), sent, jnp.uint64)
         rows_a = jnp.concatenate([rows_a, fill], axis=1)
         rows_b = jnp.concatenate([rows_b, fill], axis=1)
 
-    pp = PAIRS_PER_PROGRAM
-    b_pad = max(pp, -(-b_in // pp) * pp)
-    if b_pad != b_in:
-        pad = jnp.full((b_pad - b_in, k_pad), sent, jnp.uint64)
-        rows_a = jnp.concatenate([rows_a, pad], axis=0)
-        rows_b = jnp.concatenate([rows_b, pad], axis=0)
-
     la = k_pad // A_SUB
     sb = k_pad // B_LANE
 
     a_hi, a_lo = _split_planes(rows_a)
-    a_hi2 = a_hi.reshape(b_pad, la, A_SUB).transpose(0, 2, 1).reshape(
-        b_pad * A_SUB, la)
-    a_lo2 = a_lo.reshape(b_pad, la, A_SUB).transpose(0, 2, 1).reshape(
-        b_pad * A_SUB, la)
+    a_hi2 = a_hi.reshape(b_in, la, A_SUB).transpose(0, 2, 1).reshape(
+        b_in * A_SUB, la)
+    a_lo2 = a_lo.reshape(b_in, la, A_SUB).transpose(0, 2, 1).reshape(
+        b_in * A_SUB, la)
     b_hi, b_lo = _split_planes(rows_b)
+    b_hi2 = b_hi.reshape(b_in * sb, B_LANE)
+    b_lo2 = b_lo.reshape(b_in * sb, B_LANE)
 
-    grid = b_pad // pp
     common, total = pl.pallas_call(
         _make_kernel(la, sb, sketch_size),
-        grid=(grid,),
+        grid=(b_in,),
         in_specs=[
-            pl.BlockSpec((pp * A_SUB, la), lambda i: (i, _zi(i)),
+            pl.BlockSpec((A_SUB, la), lambda p: (p, _zi(p)),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((pp * A_SUB, la), lambda i: (i, _zi(i)),
+            pl.BlockSpec((A_SUB, la), lambda p: (p, _zi(p)),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((pp, k_pad), lambda i: (i, _zi(i)),
+            pl.BlockSpec((sb, B_LANE), lambda p: (p, _zi(p)),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((pp, k_pad), lambda i: (i, _zi(i)),
+            pl.BlockSpec((sb, B_LANE), lambda p: (p, _zi(p)),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((A_SUB, B_LANE), lambda i: (i, _zi(i)),
+            pl.BlockSpec((A_SUB, B_LANE), lambda p: (p, _zi(p)),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((A_SUB, B_LANE), lambda i: (i, _zi(i)),
+            pl.BlockSpec((A_SUB, B_LANE), lambda p: (p, _zi(p)),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((grid * A_SUB, B_LANE), jnp.int32),
-            jax.ShapeDtypeStruct((grid * A_SUB, B_LANE), jnp.int32),
+            jax.ShapeDtypeStruct((b_in * A_SUB, B_LANE), jnp.int32),
+            jax.ShapeDtypeStruct((b_in * A_SUB, B_LANE), jnp.int32),
         ],
         interpret=interpret,
-    )(a_hi2, a_lo2, b_hi, b_lo)
-    # program g's row 8g holds its 64 pairs on lanes 0..63
-    common = common.reshape(grid, A_SUB, B_LANE)[:, 0, :pp].reshape(-1)
-    total = total.reshape(grid, A_SUB, B_LANE)[:, 0, :pp].reshape(-1)
-    return common[:b_in], total[:b_in]
+    )(a_hi2, a_lo2, b_hi2, b_lo2)
+    return (common.reshape(b_in, A_SUB, B_LANE)[:, 0, 0],
+            total.reshape(b_in, A_SUB, B_LANE)[:, 0, 0])

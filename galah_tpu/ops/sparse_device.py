@@ -75,7 +75,8 @@ def _batch_pair_stats(jmat: jax.Array, pi: jax.Array, pj: jax.Array,
 
 @functools.lru_cache(maxsize=8)
 def _make_sharded_batch_stats(mesh: Mesh, sketch_size: int,
-                              use_pallas: bool = False):
+                              use_pallas: bool = False,
+                              interpret: bool = False):
     """SPMD twin: the candidate batch is sharded over the mesh axis,
     the sketch matrix is replicated; each device evaluates its slice
     of the pair list. The per-pair outputs are all-gathered back to a
@@ -85,7 +86,8 @@ def _make_sharded_batch_stats(mesh: Mesh, sketch_size: int,
 
     def spmd(jmat, pi, pj):
         c, t = _batch_pair_stats(jmat, pi, pj, sketch_size,
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas,
+                                 interpret=interpret)
         return (jax.lax.all_gather(c, "i", tiled=True),
                 jax.lax.all_gather(t, "i", tiled=True))
 
@@ -137,13 +139,14 @@ def pair_stats_for_pairs(
 
     def make_fn(pallas: bool):
         if mesh is not None and n_dev > 1:
-            return _make_sharded_batch_stats(mesh, sketch_size, pallas)
+            return _make_sharded_batch_stats(mesh, sketch_size, pallas,
+                                             interpret=interpret)
         return functools.partial(_batch_pair_stats,
                                  sketch_size=sketch_size,
                                  use_pallas=pallas,
                                  interpret=interpret)
 
-    fn = make_fn(bool(use_pallas))
+    from galah_tpu.ops._fallback import run_with_pallas_fallback
 
     pi32 = np.ascontiguousarray(pi, dtype=np.int32)
     pj32 = np.ascontiguousarray(pj, dtype=np.int32)
@@ -153,21 +156,12 @@ def pair_stats_for_pairs(
         bj = np.zeros(b, dtype=np.int32)
         bi[: e - s] = pi32[s:e]
         bj[: e - s] = pj32[s:e]
-        try:
-            c, t = fn(jmat, jnp.asarray(bi), jnp.asarray(bj))
-        except Exception:
-            if explicit or not use_pallas:
-                raise
-            # Mosaic lowering failure must not take down the sparse
-            # production path: fall back to XLA for the whole run.
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "Pallas pairlist kernel unavailable; falling back to "
-                "the XLA searchsorted path", exc_info=True)
-            use_pallas = False
-            fn = make_fn(False)
-            c, t = fn(jmat, jnp.asarray(bi), jnp.asarray(bj))
+        ji, jj = jnp.asarray(bi), jnp.asarray(bj)
+        # A Mosaic failure downgrades the remaining batches too
+        # (make_fn is cached/partial — rebuilding per batch is free).
+        (c, t), use_pallas = run_with_pallas_fallback(
+            "pairlist kernel", explicit, bool(use_pallas),
+            lambda p: make_fn(p)(jmat, ji, jj))
         common[s:e] = np.asarray(c)[: e - s]
         total[s:e] = np.asarray(t)[: e - s]
     return common, total

@@ -43,20 +43,22 @@ def main():
         m.sort(axis=1)
         return jnp.asarray(m[:n]), jnp.asarray(m[n:])
 
-    for n in (128, 512):
-        r, c = mats(n)
-        for label, fn in (
-            ("xla", lambda: tile_stats(r, c, K, 21)),
-            ("pallas", lambda: tile_stats_pallas(r, c, K)),
-            ("pallas+skip",
-             lambda: tile_stats_pallas(r, c, K, range_skip=True)),
-        ):
+    def run(label, fn, n_pairs):
+        # One bad variant (e.g. a worker crash on an oversized XLA
+        # gather — seen 2026-07-31 on xla 512x512) must not lose the
+        # rest of the capture; later variants fail fast if the client
+        # died with it, and the raw log records both.
+        try:
             best = _measure(fn)
-            print(f"{label} {n}x{n}: {best*1e3:.1f} ms = "
-                  f"{n*n/best:,.0f} pairs/s", flush=True)
+            print(f"{label}: {best*1e3:.1f} ms = "
+                  f"{n_pairs/best:,.0f} pairs/s", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"{label}: FAILED {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
 
-    # Pairlist kernel (the sparse pipeline's exact pass) vs the
-    # vmapped XLA searchsorted on the same gathered pair batch.
+    # Pairlist kernel first (the sparse production pipeline's exact
+    # pass — the most decision-relevant number) vs the vmapped XLA
+    # searchsorted on the same gathered pair batch.
     from galah_tpu.ops.pairwise import _pair_stats
     from galah_tpu.ops.pallas_pairlist import pair_stats_pairs_pallas
 
@@ -70,14 +72,18 @@ def main():
     def xla_pairs(a, bb):
         return jax.vmap(lambda x, y: _pair_stats(x, y, K))(a, bb)
 
-    for label, fn in (
-        ("pairlist-xla", lambda: xla_pairs(pa, pb)),
-        ("pairlist-mosaic",
-         lambda: pair_stats_pairs_pallas(pa, pb, K)),
-    ):
-        best = _measure(fn)
-        print(f"{label} B={b}: {best*1e3:.1f} ms = "
-              f"{b/best:,.0f} pairs/s", flush=True)
+    run(f"pairlist-mosaic B={b}",
+        lambda: pair_stats_pairs_pallas(pa, pb, K), b)
+    run(f"pairlist-xla B={b}", lambda: xla_pairs(pa, pb), b)
+
+    for n in (128, 512):
+        r, c = mats(n)
+        run(f"pallas {n}x{n}", lambda: tile_stats_pallas(r, c, K),
+            n * n)
+        run(f"pallas+skip {n}x{n}",
+            lambda: tile_stats_pallas(r, c, K, range_skip=True), n * n)
+        if n <= 128:  # xla 512x512 crashed the TPU worker (see above)
+            run(f"xla {n}x{n}", lambda: tile_stats(r, c, K, 21), n * n)
 
 
 if __name__ == "__main__":

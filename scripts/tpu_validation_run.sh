@@ -1,9 +1,12 @@
 #!/bin/bash
 # Opportunistic TPU validation: wait for a responsive tunnel, then run
 # the hardware kernel validation, the benchmark, and the TPU ladder in
-# sequence, logging everything to scripts/tpu_validation.log.
+# sequence. Everything logs to scripts/tpu_validation.log (gitignored,
+# live) AND to a dated capture dir under docs/artifacts/ (tracked) so
+# a successful session is committable as-is.
 set -u
 LOG=/root/repo/scripts/tpu_validation.log
+ART=/root/repo/docs/artifacts/tpu_watch_$(date -u +%Y%m%d_%H%M)
 cd /root/repo
 echo "=== tpu_validation_run $(date -u) ===" >> "$LOG"
 
@@ -19,21 +22,22 @@ for attempt in $(seq 1 60); do
   if [ "$attempt" = 60 ]; then echo "giving up" >> "$LOG"; exit 1; fi
 done
 
-echo "--- test_tpu_hw ---" >> "$LOG"
-timeout 2400 python -m pytest tests/test_tpu_hw.py -q >> "$LOG" 2>&1
+mkdir -p "$ART"
+run_stage() {  # run_stage <name> <timeout> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "--- $name $(date -u) ---" >> "$LOG"
+  { echo "=== $name $(date -u) ==="
+    timeout -k 10 "$tmo" "$@" 2>&1
+    echo "--- exit $? $(date -u) ---"
+  } > "$ART/$name.txt"
+  cat "$ART/$name.txt" >> "$LOG"
+}
 
-echo "--- bench.py ---" >> "$LOG"
-timeout 1800 python bench.py >> "$LOG" 2>/dev/null
+run_stage test_tpu_hw 2400 python -m pytest tests/test_tpu_hw.py -q
+run_stage bench 2400 python bench.py
+run_stage sketch_variants 1200 python scripts/bench_sketch_variants.py
+run_stage kernel_variants 1200 python scripts/bench_kernel_variants.py
+run_stage ladder_tpu 2400 python scripts/ladder_bench.py --n 100 \
+  --genome-len 300000 --skip-rung1 --hash tpufast --ani-subsample 16
 
-echo "--- sketch variants ---" >> "$LOG"
-timeout 1200 python scripts/bench_sketch_variants.py >> "$LOG" 2>&1
-
-echo "--- pair-stats kernel variants ---" >> "$LOG"
-timeout 1200 python scripts/bench_kernel_variants.py >> "$LOG" 2>&1
-
-echo "--- ladder (tpu, tpufast c=16) ---" >> "$LOG"
-timeout 2400 python scripts/ladder_bench.py --n 100 \
-  --genome-len 300000 --skip-rung1 --hash tpufast \
-  --ani-subsample 16 >> "$LOG" 2>/dev/null
-
-echo "=== done $(date -u) ===" >> "$LOG"
+echo "=== done $(date -u) — captures in $ART ===" >> "$LOG"
