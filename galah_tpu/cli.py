@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 
 import galah_tpu
@@ -28,6 +29,13 @@ def _add_verbosity(p: argparse.ArgumentParser) -> None:
                    help="Print extra debugging information")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="Unless there is an error, do not print log messages")
+    p.add_argument("--platform", default=None,
+                   help="Force the JAX platform (e.g. cpu, tpu). Wins over "
+                        "site-wide defaults that pin a device backend — "
+                        "JAX_PLATFORMS alone can be overridden by an "
+                        "interpreter sitecustomize, this flag cannot. Env "
+                        "equivalent: GALAH_TPU_PLATFORM. Default: the "
+                        "interpreter's JAX default")
     p.add_argument("--full-help", action="store_true",
                    help="Display an extended man-style help page and exit")
     p.add_argument("--full-help-roff", action="store_true",
@@ -357,6 +365,28 @@ def main(argv=None) -> int:
         return 0
     set_log_level(verbose=getattr(args, "verbose", False),
                   quiet=getattr(args, "quiet", False))
+    platform = (getattr(args, "platform", None)
+                or os.environ.get("GALAH_TPU_PLATFORM"))
+    if platform:
+        # Must land before the first jax USE (backend init), which only
+        # happens inside the subcommands — the lazy import layout above
+        # guarantees that. jax.config wins over the JAX_PLATFORMS env
+        # var even when an interpreter sitecustomize pinned it.
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        try:
+            # Probe now so a bad/unavailable platform is a clean
+            # one-line user error, not a traceback at first device use.
+            # jax surfaces this as RuntimeError or, with plugin-patched
+            # bridges, a bare AssertionError — any failure here means
+            # the forced platform cannot initialize.
+            jax.default_backend()
+        except Exception as e:  # noqa: BLE001
+            msg = str(e).splitlines()[0] if str(e) else type(e).__name__
+            logger.error("--platform %s: backend failed to initialize "
+                         "(%s)", platform, msg)
+            return 1
     logger.info("galah-tpu version %s", galah_tpu.__version__)
     try:
         if args.subcommand == "cluster":

@@ -381,3 +381,64 @@ def test_validate_output_paths_mirrors_setup(tmp_path):
 
             if "representative_fasta_directory" in kwargs:
                 shutil.rmtree(tmp_path / "a")
+
+
+def test_platform_flag_forces_backend(tmp_path):
+    """--platform cpu must win over any interpreter-level platform
+    default (a sitecustomize pinning a device backend overrides the
+    JAX_PLATFORMS env var, so the flag goes through jax.config, which
+    that cannot override). Run in a subprocess with the test env's
+    platform pins stripped so the interpreter default applies."""
+    import subprocess
+    import sys
+
+    out = tmp_path / "dist.tsv"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    # Pin a CONFLICTING platform so the test is not vacuous on
+    # CPU-only hosts: without the flag's jax.config override, cuda
+    # (absent from this image) would fail backend init; the flag
+    # must beat the env pin.
+    env["JAX_PLATFORMS"] = "cuda"
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    code = (
+        "import sys\n"
+        "from galah_tpu.cli import main\n"
+        f"rc = main(['dist', '--platform', 'cpu',\n"
+        f"           '--genome-fasta-files', '{DATA}/set1/1mbp.fna',\n"
+        f"           '{DATA}/set1/500kb.fna',\n"
+        f"           '--output', '{out}'])\n"
+        "import jax\n"
+        "assert jax.default_backend() == 'cpu', jax.default_backend()\n"
+        "sys.exit(rc)\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=420,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    ani = float(out.read_text().split("\t")[2])
+    assert abs(ani - 0.9808188) < 5e-7
+
+
+def test_platform_flag_bad_value_clean_error(tmp_path):
+    """An unavailable --platform is a one-line user error, exit 1 —
+    not a RuntimeError traceback at first device use."""
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    code = (
+        "import sys\n"
+        "from galah_tpu.cli import main\n"
+        f"sys.exit(main(['dist', '--platform', 'cuda',\n"
+        f"               '--genome-fasta-files', '{DATA}/set1/1mbp.fna',\n"
+        f"               '{DATA}/set1/500kb.fna',\n"
+        f"               '--output', '{tmp_path / 'd.tsv'}']))\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=420,
+                          env=env)
+    assert proc.returncode == 1, (proc.returncode, proc.stderr[-500:])
+    assert "Traceback" not in proc.stderr
+    assert "--platform cuda" in proc.stderr and "failed to initialize" in proc.stderr

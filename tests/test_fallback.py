@@ -1,0 +1,91 @@
+"""The shared explicit-pin / default-fallback policy for Mosaic kernels.
+
+One helper (ops/_fallback.py) now carries the contract that four call
+sites (threshold_pairs, screen_pairs, hll_threshold_pairs, and the
+sparse pairlist batcher) previously duplicated: an explicitly pinned
+path fails loudly — parity tests must never vacuously compare XLA to
+XLA — while the default path downgrades to the XLA twin with a logged
+warning when Mosaic lowering fails.
+"""
+
+import logging
+
+import pytest
+
+from galah_tpu.ops._fallback import run_with_pallas_fallback
+
+
+class _Boom(RuntimeError):
+    pass
+
+
+def test_pallas_success_returns_result_and_flag():
+    result, used = run_with_pallas_fallback(
+        "test kernel", explicit=False, use_pallas=True,
+        run=lambda p: ("ran", p))
+    assert result == ("ran", True)
+    assert used is True
+
+
+def test_default_fallback_runs_xla_and_warns(caplog):
+    calls = []
+
+    def run(p):
+        calls.append(p)
+        if p:
+            raise _Boom("no lowering")
+        return "xla"
+
+    with caplog.at_level(logging.WARNING, "galah_tpu.ops._fallback"):
+        result, used = run_with_pallas_fallback(
+            "test kernel", explicit=False, use_pallas=True, run=run)
+    assert result == "xla"
+    assert used is False
+    assert calls == [True, False]
+    assert any("test kernel" in r.message and "falling back" in r.message
+               for r in caplog.records)
+
+
+def test_explicit_pin_propagates_failure():
+    def run(p):
+        raise _Boom("no lowering")
+
+    with pytest.raises(_Boom):
+        run_with_pallas_fallback(
+            "test kernel", explicit=True, use_pallas=True, run=run)
+
+
+def test_use_pallas_false_skips_mosaic_entirely():
+    calls = []
+    result, used = run_with_pallas_fallback(
+        "test kernel", explicit=True, use_pallas=False,
+        run=lambda p: calls.append(p) or "xla")
+    assert result == "xla"
+    assert used is False
+    assert calls == [False]
+
+
+def test_xla_failure_always_propagates():
+    with pytest.raises(_Boom):
+        run_with_pallas_fallback(
+            "test kernel", explicit=False, use_pallas=False,
+            run=lambda p: (_ for _ in ()).throw(_Boom()))
+
+
+def test_downgrade_loop_pattern():
+    """The sparse batcher's loop: after one failure the returned flag
+    keeps later batches off the Mosaic path without retrying it."""
+    attempts = []
+
+    def run(p):
+        attempts.append(p)
+        if p:
+            raise _Boom()
+        return "xla"
+
+    use_pallas = True
+    for _ in range(3):
+        _, use_pallas = run_with_pallas_fallback(
+            "test kernel", explicit=False, use_pallas=use_pallas,
+            run=run)
+    assert attempts == [True, False, False, False]
