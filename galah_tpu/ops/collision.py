@@ -102,7 +102,25 @@ def collision_pair_counts(mat: np.ndarray, lens: np.ndarray):
 
     Returns (pi, pj, counts) with pi < pj, int64. Pairs with zero
     collisions are not enumerated.
+
+    The compiled counter (csrc/collision.c: radix sort + run walk +
+    hashmap) carries the pass when it builds — the numpy formulation
+    (_collision_pair_counts_np) is the always-available fallback and
+    the semantic reference (parity pinned in tests/test_collision.py).
+    This is host-side work on every backend, so unlike the device-twin
+    C paths there is no backend gate — only availability.
     """
+    try:
+        from galah_tpu.ops._ccollision import collision_pair_counts_c
+
+        return collision_pair_counts_c(mat, lens, _BIG_RUN)
+    except ImportError:
+        pass
+    return _collision_pair_counts_np(mat, lens)
+
+
+def _collision_pair_counts_np(mat: np.ndarray, lens: np.ndarray):
+    """Numpy reference implementation (see collision_pair_counts)."""
     n = mat.shape[0]
     ids = np.repeat(np.arange(n, dtype=np.int64), lens)
     hv = mat[mat != np.uint64(SENTINEL)]

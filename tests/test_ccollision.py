@@ -1,0 +1,135 @@
+"""Native collision counter (csrc/collision.c) vs the numpy reference.
+
+The C path must produce bit-identical (pi, pj, counts) triples in the
+same order as _collision_pair_counts_np for every input class the
+screen sees: planted families, near-duplicate mega-clusters (big-run
+group dedup), ragged row lengths, empty rows, and adversarial
+random fuzz. collision_pair_counts auto-routes to C when it builds,
+so every consumer (threshold_pairs_c, screen_pairs, the device sparse
+path) inherits the speedup with the semantics pinned here.
+"""
+
+import numpy as np
+import pytest
+
+from galah_tpu.ops.collision import (
+    _BIG_RUN,
+    _collision_pair_counts_np,
+    collision_pair_counts,
+)
+from galah_tpu.ops.constants import SENTINEL
+
+try:
+    from galah_tpu.ops._ccollision import collision_pair_counts_c
+except ImportError:  # pragma: no cover - toolchain-less environments
+    collision_pair_counts_c = None
+
+needs_c = pytest.mark.skipif(collision_pair_counts_c is None,
+                             reason="C toolchain unavailable")
+
+
+def _assert_identical(mat, lens):
+    got = collision_pair_counts_c(mat, lens, _BIG_RUN)
+    want = _collision_pair_counts_np(mat, lens)
+    for g, w, name in zip(got, want, ("pi", "pj", "counts")):
+        np.testing.assert_array_equal(g, w, err_msg=name)
+
+
+def _family_matrix(rng, n, k, fam):
+    base = rng.integers(0, 1 << 62, size=(fam, k), dtype=np.uint64)
+    mat = np.empty((n, k), np.uint64)
+    for i in range(n):
+        row = base[i % fam].copy()
+        n_mut = int(rng.integers(0, max(1, k // 8)))
+        idx = rng.choice(k, size=n_mut, replace=False)
+        row[idx] = rng.integers(0, 1 << 62, size=n_mut, dtype=np.uint64)
+        row.sort()
+        mat[i] = row
+    return mat
+
+
+@needs_c
+def test_planted_families_identical():
+    rng = np.random.default_rng(11)
+    mat = _family_matrix(rng, 64, 96, 16)
+    lens = np.full(64, 96, np.int64)
+    _assert_identical(mat, lens)
+
+
+@needs_c
+def test_mega_cluster_big_run_dedup_identical():
+    rng = np.random.default_rng(12)
+    k = 48
+    shared = np.sort(rng.integers(0, 1 << 62, size=k, dtype=np.uint64))
+    n = _BIG_RUN * 2 + 7   # every shared hash makes a run > _BIG_RUN
+    mat = np.tile(shared, (n, 1))
+    # a second, partially-overlapping mega-group exercises distinct
+    # group signatures
+    mat[n // 2:, : k // 2] = np.sort(
+        rng.integers(0, 1 << 62, size=k // 2, dtype=np.uint64))
+    mat.sort(axis=1)
+    lens = np.full(n, k, np.int64)
+    _assert_identical(mat, lens)
+
+
+@needs_c
+def test_ragged_and_empty_rows_identical():
+    rng = np.random.default_rng(13)
+    n, k = 50, 32
+    mat = np.full((n, k), np.uint64(SENTINEL), dtype=np.uint64)
+    lens = np.zeros(n, np.int64)
+    pool = rng.integers(0, 1 << 16, size=64, dtype=np.uint64)  # dense
+    for i in range(n):
+        m = int(rng.integers(0, k + 1))
+        lens[i] = m
+        if m:
+            vals = rng.choice(pool, size=m, replace=False)
+            mat[i, :m] = np.sort(vals)
+    _assert_identical(mat, lens)
+
+
+@needs_c
+def test_all_empty_and_no_collisions():
+    n, k = 8, 16
+    mat = np.full((n, k), np.uint64(SENTINEL), dtype=np.uint64)
+    lens = np.zeros(n, np.int64)
+    _assert_identical(mat, lens)
+    rng = np.random.default_rng(14)
+    mat2 = np.sort(rng.integers(0, 1 << 62, size=(n, k),
+                                dtype=np.uint64), axis=1)
+    _assert_identical(mat2, np.full(n, k, np.int64))
+
+
+@needs_c
+def test_fuzz_identical_across_structures():
+    rng = np.random.default_rng(15)
+    for trial in range(25):
+        n = int(rng.integers(2, 120))
+        k = int(rng.integers(1, 64))
+        fam = int(rng.integers(1, max(2, n // 2)))
+        if trial % 3 == 0:
+            # collision-dense small universe
+            mat = np.empty((n, k), np.uint64)
+            for i in range(n):
+                mat[i] = np.sort(rng.choice(
+                    np.arange(4 * k, dtype=np.uint64), size=k,
+                    replace=False))
+        else:
+            mat = _family_matrix(rng, n, k, fam)
+        lens = rng.integers(0, k + 1, size=n).astype(np.int64)
+        mm = np.full((n, k), np.uint64(SENTINEL), dtype=np.uint64)
+        for i in range(n):
+            mm[i, : lens[i]] = mat[i, : lens[i]]
+        _assert_identical(mm, lens)
+
+
+@needs_c
+def test_auto_route_uses_c():
+    """collision_pair_counts routes to the C counter when it builds."""
+    rng = np.random.default_rng(16)
+    mat = _family_matrix(rng, 32, 40, 8)
+    lens = np.full(32, 40, np.int64)
+    got = collision_pair_counts(mat, lens)
+    want = collision_pair_counts_c(mat, lens, _BIG_RUN)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)

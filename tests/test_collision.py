@@ -48,7 +48,10 @@ def test_exact_vs_brute_force_mixed_runs():
 
 
 def test_compaction_threshold_is_exercised(monkeypatch):
-    """Force tiny compaction chunks; results stay exact."""
+    """Force tiny compaction chunks; results stay exact. Pins the
+    numpy reference path directly — collision_pair_counts auto-routes
+    to the C counter when it builds, which never reads
+    _COMPACT_EVERY."""
     import galah_tpu.ops.collision as col
 
     monkeypatch.setattr(col, "_COMPACT_EVERY", 16)
@@ -60,7 +63,7 @@ def test_compaction_threshold_is_exercised(monkeypatch):
         for _ in range(n)
     ])
     lens = np.full(n, width, dtype=np.int64)
-    pi, pj, counts = col.collision_pair_counts(mat, lens)
+    pi, pj, counts = col._collision_pair_counts_np(mat, lens)
     got = {(int(a), int(b)): int(c) for a, b, c in zip(pi, pj, counts)}
     assert got == _brute(mat, lens)
     assert _COMPACT_EVERY > 16  # the real threshold is untouched
