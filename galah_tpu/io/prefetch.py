@@ -12,11 +12,40 @@ in-flight window, so the bound is O(depth + workers), never O(N).
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterator, Sequence, Tuple, TypeVar
 
 T = TypeVar("T")
 V = TypeVar("V")
+
+# One shared executor, lazily created and grown to the largest worker
+# count ever requested. The greedy engine streams thousands of tiny
+# per-precluster loads through these helpers; a pool per call (the
+# original shape) measured ~100 s of pure thread create/join/lock
+# overhead at N=100k (24k threads). Look-ahead bounds stay per-call —
+# each caller keeps its own in-flight window, the pool is just where
+# the work runs.
+_POOL: ThreadPoolExecutor | None = None
+_POOL_SIZE = 0
+_POOL_LOCK = threading.Lock()
+
+
+def _shared_pool(workers: int) -> ThreadPoolExecutor:
+    global _POOL, _POOL_SIZE
+    workers = max(1, int(workers))
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_SIZE < workers:
+            # Replace WITHOUT shutting the old pool down: live
+            # generators captured it and must keep submitting
+            # (shutdown would raise RuntimeError mid-stream). Its
+            # worker threads exit via the executor's weakref wind-down
+            # once the last holder releases it.
+            _POOL = ThreadPoolExecutor(
+                max_workers=workers,
+                thread_name_prefix="galah-prefetch")
+            _POOL_SIZE = workers
+        return _POOL
 
 
 def probe_and_prefetch(
@@ -94,26 +123,26 @@ def process_stream(
     elif workers > 1:
         from collections import deque
 
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            it = iter(items)
-            pending: deque = deque()
+        pool = _shared_pool(workers)
+        it = iter(items)
+        pending: deque = deque()
 
-            def submit_next() -> bool:
-                try:
-                    p, item = next(it)
-                except StopIteration:
-                    return False
-                pending.append((p, pool.submit(single_fn, p, item)))
-                return True
+        def submit_next() -> bool:
+            try:
+                p, item = next(it)
+            except StopIteration:
+                return False
+            pending.append((p, pool.submit(single_fn, p, item)))
+            return True
 
-            for _ in range(2 * workers):
-                if not submit_next():
-                    break
-            while pending:
-                p, fut = pending.popleft()
-                result = fut.result()
-                submit_next()
-                yield p, result
+        for _ in range(2 * workers):
+            if not submit_next():
+                break
+        while pending:
+            p, fut = pending.popleft()
+            result = fut.result()
+            submit_next()
+            yield p, result
     else:
         for p, it_ in items:
             yield p, single_fn(p, it_)
@@ -130,14 +159,13 @@ def iter_prefetched(
     depth = max(1, int(depth))
     if not paths:
         return
-    with ThreadPoolExecutor(max_workers=depth) as pool:
-        pending = []
-        idx = 0
-        for idx in range(min(depth, len(paths))):
-            pending.append(pool.submit(load_fn, paths[idx]))
-        for i, path in enumerate(paths):
-            fut = pending.pop(0)
-            nxt = i + depth
-            if nxt < len(paths):
-                pending.append(pool.submit(load_fn, paths[nxt]))
-            yield path, fut.result()
+    pool = _shared_pool(depth)
+    pending = []
+    for idx in range(min(depth, len(paths))):
+        pending.append(pool.submit(load_fn, paths[idx]))
+    for i, path in enumerate(paths):
+        fut = pending.pop(0)
+        nxt = i + depth
+        if nxt < len(paths):
+            pending.append(pool.submit(load_fn, paths[nxt]))
+        yield path, fut.result()

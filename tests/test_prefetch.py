@@ -99,3 +99,21 @@ def test_process_stream_workers_propagates_errors():
         assert str(e) == "x"
     else:
         raise AssertionError("expected RuntimeError")
+
+
+def test_live_stream_survives_pool_growth():
+    """A partially-consumed stream holds the shared pool it started
+    on; a later, larger request must not shut that pool down under it
+    (regression: mid-stream RuntimeError after replacement)."""
+    import time
+
+    from galah_tpu.io.prefetch import _shared_pool, iter_prefetched
+
+    def slow(p):
+        time.sleep(0.005)
+        return p.upper()
+
+    gen = iter_prefetched([f"p{i}" for i in range(8)], slow, depth=2)
+    assert next(gen) == ("p0", "P0")
+    _shared_pool(64)  # force a replacement while gen is live
+    assert list(gen) == [(f"p{i}", f"P{i}") for i in range(1, 8)]
