@@ -223,6 +223,43 @@ int64_t galah_positional_hashes(const uint8_t *codes, int64_t n,
     return n - k + 1;
 }
 
+/* Positional hashes with the FracMinHash subsample mask and the valid
+ * compaction folded into the same walk — the profile build's whole
+ * host post-pass (np.where + boolean filter over an 8-byte-per-bp
+ * array) collapses into it. cut == 0 means keep every valid hash;
+ * cut > 0 keeps h < cut and masks the rest to the sentinel (the
+ * FracMinHash criterion, reference analog: skani's c compression,
+ * src/skani.rs:159-161). valid_out (capacity n - k + 1) receives the
+ * kept hashes in genome order, duplicates included; *n_valid_out gets
+ * the count. Returns n - k + 1, or 0 when n < k. */
+int64_t galah_positional_hashes_masked(
+    const uint8_t *codes, int64_t n, const int64_t *offsets,
+    int64_t n_offsets, int k, uint64_t seed, int algo, uint64_t cut,
+    uint64_t *out, uint64_t *valid_out, int64_t *n_valid_out) {
+    *n_valid_out = 0;
+    if (n < k || k < 1 || k > 32) return 0;
+    const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
+    int64_t nv = 0;
+    GALAH_WALK(codes, n, offsets, n_offsets, k, seed, algo,
+               {
+                   if (!cut) {
+                       /* keep-all: flat holds the raw hash; the valid
+                        * list still excludes a natural sentinel-valued
+                        * hash, matching the numpy != SENTINEL filter */
+                       out[WPOS] = WHASH;
+                       if (WHASH != SENT) valid_out[nv++] = WHASH;
+                   } else if (WHASH < cut) {
+                       out[WPOS] = WHASH;
+                       valid_out[nv++] = WHASH;
+                   } else {
+                       out[WPOS] = SENT;
+                   }
+               },
+               out[WPOS] = SENT);
+    *n_valid_out = nv;
+    return n - k + 1;
+}
+
 /* ---------------- HLL registers ------------------------------------ */
 
 /* 2^p uint8 HyperLogLog registers over the genome's canonical k-mer

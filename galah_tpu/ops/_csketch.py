@@ -49,6 +49,17 @@ _fn_pos.argtypes = [
 ]
 
 
+_fn_pos_masked = _lib.galah_positional_hashes_masked
+_fn_pos_masked.restype = ctypes.c_int64
+_fn_pos_masked.argtypes = [
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.POINTER(ctypes.c_int64),
+]
+
+
 _fn_hll = _lib.galah_hll_registers
 _fn_hll.restype = ctypes.c_int64
 _fn_hll.argtypes = [
@@ -122,3 +133,33 @@ def positional_hashes(codes: np.ndarray, contig_offsets, k: int,
         _ALGOS[algo],
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
     return out[:max(got, 0)]
+
+
+def positional_hashes_masked(
+        codes: np.ndarray, contig_offsets, k: int, cut: int,
+        seed: int = 0,
+        algo: str = "murmur3") -> "tuple[np.ndarray, np.ndarray]":
+    """(flat, valid): every window's canonical hash with the
+    FracMinHash mask (hashes >= cut -> SENTINEL; cut=0 keeps all) and
+    the kept hashes compacted in genome order — the profile build's
+    hash walk and host post-pass in one C pass. Bit-identical to
+    positional_hashes + np.where + the != SENTINEL filter."""
+    _check(algo, k)
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    offs = np.ascontiguousarray(contig_offsets, dtype=np.int64)
+    n = codes.shape[0]
+    if n < k:
+        return (np.zeros(0, dtype=np.uint64),
+                np.zeros(0, dtype=np.uint64))
+    out = np.empty(n - k + 1, dtype=np.uint64)
+    valid = np.empty(n - k + 1, dtype=np.uint64)
+    n_valid = ctypes.c_int64(0)
+    got = _fn_pos_masked(
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offs.shape[0], int(k), int(seed) & 0xFFFFFFFFFFFFFFFF,
+        _ALGOS[algo], int(cut) & 0xFFFFFFFFFFFFFFFF,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.byref(n_valid))
+    return out[:max(got, 0)], valid[:n_valid.value].copy()

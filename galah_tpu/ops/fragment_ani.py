@@ -203,6 +203,19 @@ def _check_subsample(subsample_c: int) -> None:
             f"subsample_c must be in [1, {MARKER_C}], got {subsample_c}")
 
 
+def _finish_profile(path: str, flat: np.ndarray, valid: np.ndarray,
+                    k: int, fraglen: int,
+                    subsample_c: int) -> GenomeProfile:
+    """Distinct set + marker slice + construction — the one tail
+    shared by the C single-pass and generic profile builds."""
+    ref_set = np.unique(valid)
+    markers = ref_set[ref_set < np.uint64((1 << 64) // MARKER_C)]
+    return GenomeProfile(
+        path=path, k=k, fraglen=fraglen,
+        flat_hashes=flat, ref_set=ref_set, markers=markers,
+        subsample_c=subsample_c)
+
+
 def _profile_from_flat(path: str, flat: np.ndarray, k: int, fraglen: int,
                        subsample_c: int) -> GenomeProfile:
     """Host post-pass shared by single and batched profile builds:
@@ -212,12 +225,37 @@ def _profile_from_flat(path: str, flat: np.ndarray, k: int, fraglen: int,
         cut = np.uint64((1 << 64) // subsample_c)
         flat = np.where(flat < cut, flat, np.uint64(SENTINEL))
     valid = flat[flat != np.uint64(SENTINEL)]
-    ref_set = np.unique(valid)
-    markers = ref_set[ref_set < np.uint64((1 << 64) // MARKER_C)]
-    return GenomeProfile(
-        path=path, k=k, fraglen=fraglen,
-        flat_hashes=flat, ref_set=ref_set, markers=markers,
-        subsample_c=subsample_c)
+    return _finish_profile(path, flat, valid, k, fraglen, subsample_c)
+
+
+def _c_profile_available(k: int) -> bool:
+    """Gate for the C single-pass profile build — genome-independent
+    by construction (backend, k width, toolchain), so callers may
+    decide once per batch."""
+    if jax.default_backend() != "cpu" or k > 32:
+        return False
+    try:
+        from galah_tpu.ops import _csketch  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def _profile_via_c(genome: Genome, k: int, fraglen: int,
+                   subsample_c: int) -> GenomeProfile:
+    """Single-pass C profile build: hash walk + FracMinHash mask +
+    valid compaction in one sweep (csrc/sketch.c::
+    galah_positional_hashes_masked), leaving only a small np.unique on
+    the kept hashes. Bit-identical to the _profile_from_flat post-pass
+    (parity: tests/test_csketch.py). Callers must check
+    _c_profile_available first."""
+    from galah_tpu.ops import _csketch
+
+    cut = 0 if subsample_c == 1 else (1 << 64) // subsample_c
+    flat, valid = _csketch.positional_hashes_masked(
+        genome.codes, genome.contig_offsets, k=k, cut=cut)
+    return _finish_profile(genome.path, flat, valid, k, fraglen,
+                           subsample_c)
 
 
 def build_profile(genome: Genome, k: int, fraglen: int,
@@ -236,6 +274,8 @@ def build_profile(genome: Genome, k: int, fraglen: int,
     unchanged.
     """
     _check_subsample(subsample_c)  # fail before any device hashing
+    if _c_profile_available(k):
+        return _profile_via_c(genome, k, fraglen, subsample_c)
     return _profile_from_flat(genome.path, positional_hashes(genome, k),
                               k, fraglen, subsample_c)
 
@@ -246,6 +286,12 @@ def build_profiles_batch(genomes, k: int, fraglen: int,
     instead of per genome (reference analog: skani's fastx_to_sketches
     over all files, src/skani.rs:46)."""
     _check_subsample(subsample_c)  # fail before any device hashing
+    if genomes and _c_profile_available(k):
+        # CPU backend with the C walker: per-genome single-pass builds
+        # beat device batch grouping (no dispatch round trips to
+        # amortize).
+        return [_profile_via_c(g, k, fraglen, subsample_c)
+                for g in genomes]
     flats = positional_hashes_batch(genomes, k)
     return [
         _profile_from_flat(g.path, flat, k, fraglen, subsample_c)

@@ -126,3 +126,70 @@ def test_c_hll_registers_match_jax(tmp_path):
         # and the default path selects the C twin with identical output
         np.testing.assert_array_equal(
             np.asarray(hll.hll_sketch_genome(g, p=10, algo=algo)), got)
+
+
+def test_positional_hashes_masked_parity():
+    """The single-pass masked walk (flat + compacted valid) must equal
+    positional_hashes + np.where + the != SENTINEL filter for every
+    algo, subsample, contig structure, and ambiguity pattern."""
+    from galah_tpu.ops import _csketch
+    from galah_tpu.ops.constants import SENTINEL
+
+    rng = np.random.default_rng(31)
+    for trial in range(12):
+        n = int(rng.integers(1, 4000))
+        codes = rng.integers(0, 4, size=n).astype(np.uint8)
+        # ambiguity islands
+        for _ in range(int(rng.integers(0, 4))):
+            s = int(rng.integers(0, n))
+            codes[s:s + int(rng.integers(1, 9))] = 255
+        n_contigs = int(rng.integers(1, 4))
+        cuts = np.sort(rng.choice(np.arange(1, max(2, n)),
+                                  size=n_contigs - 1, replace=False))
+        offs = np.concatenate([[0], cuts, [n]]).astype(np.int64)
+        k = int(rng.integers(1, 33))
+        algo = ("murmur3", "tpufast")[trial % 2]
+        c = (1, 4, 16, 125)[trial % 4]
+        cut = 0 if c == 1 else (1 << 64) // c
+
+        want_flat = _csketch.positional_hashes(codes, offs, k=k,
+                                               algo=algo)
+        if c > 1:
+            want_flat = np.where(
+                want_flat < np.uint64(cut), want_flat,
+                np.uint64(SENTINEL))
+        want_valid = want_flat[want_flat != np.uint64(SENTINEL)]
+
+        flat, valid = _csketch.positional_hashes_masked(
+            codes, offs, k=k, cut=cut, algo=algo)
+        np.testing.assert_array_equal(flat, want_flat)
+        np.testing.assert_array_equal(valid, want_valid)
+
+
+def test_profile_via_c_matches_generic(tmp_path):
+    """The C single-pass profile equals the generic build exactly."""
+    import jax
+
+    from galah_tpu.io.fasta import Genome, GenomeStats
+    from galah_tpu.ops.fragment_ani import (_profile_from_flat,
+                                            _profile_via_c,
+                                            positional_hashes)
+
+    assert jax.default_backend() == "cpu"
+    rng = np.random.default_rng(32)
+    codes = rng.integers(0, 4, size=30_000).astype(np.uint8)
+    codes[500:520] = 255
+    g = Genome(path="g.fna", codes=codes,
+               contig_offsets=np.array([0, 11_000, 30_000],
+                                       dtype=np.int64),
+               stats=GenomeStats(2, 20, 19_000))
+    for c in (1, 16):
+        got = _profile_via_c(g, 15, 3000, c)
+        assert got is not None
+        want = _profile_from_flat(
+            g.path, positional_hashes(g, 15), 15, 3000, c)
+        np.testing.assert_array_equal(got.flat_hashes, want.flat_hashes)
+        np.testing.assert_array_equal(got.ref_set, want.ref_set)
+        np.testing.assert_array_equal(got.markers, want.markers)
+        assert (got.k, got.fraglen, got.subsample_c) == (
+            want.k, want.fraglen, want.subsample_c)

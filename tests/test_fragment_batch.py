@@ -161,3 +161,37 @@ def test_profile_store_get_many_batched_branch(tmp_path, monkeypatch):
     for a, b in zip(plain, batched):
         np.testing.assert_array_equal(a.flat_hashes, b.flat_hashes)
         np.testing.assert_array_equal(a.ref_set, b.ref_set)
+
+
+def test_generic_batch_path_matches_c_path(tmp_path):
+    """The generic grouped-dispatch profile build (positional_hashes_batch
+    + _profile_from_flat) must stay bit-identical to the C single-pass
+    builder — on CPU the C path short-circuits build_profiles_batch, so
+    this pins the generic path explicitly against it (regression
+    coverage the auto-routing otherwise removes)."""
+    import numpy as np
+
+    from galah_tpu.io.fasta import Genome, GenomeStats
+    from galah_tpu.ops import fragment_ani as fa
+
+    rng = np.random.default_rng(41)
+    genomes = []
+    for i in range(3):
+        n = int(rng.integers(500, 40_000))
+        codes = rng.integers(0, 4, size=n).astype(np.uint8)
+        codes[n // 3: n // 3 + 10] = 255
+        cut = int(rng.integers(1, n))
+        genomes.append(Genome(
+            path=f"g{i}.fna", codes=codes,
+            contig_offsets=np.array([0, cut, n], dtype=np.int64),
+            stats=GenomeStats(2, 10, n)))
+    for c in (1, 16):
+        assert fa._c_profile_available(15)
+        via_c = [fa._profile_via_c(g, 15, 3000, c) for g in genomes]
+        flats = fa.positional_hashes_batch(genomes, 15)
+        generic = [fa._profile_from_flat(g.path, flat, 15, 3000, c)
+                   for g, flat in zip(genomes, flats)]
+        for a, b in zip(via_c, generic):
+            np.testing.assert_array_equal(a.flat_hashes, b.flat_hashes)
+            np.testing.assert_array_equal(a.ref_set, b.ref_set)
+            np.testing.assert_array_equal(a.markers, b.markers)
