@@ -258,3 +258,47 @@ int64_t galah_pair_stats_threshold(
     }
     return total;
 }
+
+/* Compacted positional-hash window builder — the C twin of the
+ * subsample_c > 1 branch of ops/fragment_ani GenomeProfile.windows():
+ * surviving (non-sentinel) hashes move to the front of each fragment
+ * row, k-mers crossing the fragment boundary (in-row position >=
+ * L - (k - 1)) are dropped, row order of survivors is preserved. The
+ * numpy formulation is a stable argsort over the full (W, L) array
+ * (~150 ms per 3 Mbp genome); these two streaming passes replace it.
+ *
+ * Pass 1: per-row survivor counts (galah_window_survivor_counts) so
+ * the caller can size `slots`. Pass 2: fill `wins` (W x slots,
+ * prefilled with the sentinel by the caller). */
+
+void galah_window_survivor_counts(const uint64_t *flat, int64_t n_flat,
+                                  int64_t W, int64_t L, int k,
+                                  int64_t *counts) {
+    const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
+    const int64_t keep = L - (k - 1);
+    for (int64_t r = 0; r < W; r++) counts[r] = 0;
+    for (int64_t i = 0; i < n_flat; i++) {
+        if (flat[i] == SENT) continue;
+        int64_t r = i / L;
+        if (i - r * L < keep) counts[r]++;
+    }
+}
+
+void galah_fill_compact_windows(const uint64_t *flat, int64_t n_flat,
+                                int64_t W, int64_t L, int k,
+                                int64_t slots, uint64_t *wins) {
+    const int64_t keep = L - (k - 1);
+    const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
+    int64_t fill = 0, row = 0;
+    for (int64_t i = 0; i < n_flat; i++) {
+        int64_t r = i / L;
+        if (r != row) {
+            row = r;
+            fill = 0;
+        }
+        if (flat[i] == SENT || i - r * L >= keep) continue;
+        wins[r * slots + fill++] = flat[i];
+    }
+    (void)W;
+    (void)SENT;
+}

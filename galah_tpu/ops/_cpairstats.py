@@ -150,3 +150,52 @@ def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,
     m = int(min(total, cap))
     return {(int(out_i[x]), int(out_j[x])): float(out_ani[x])
             for x in range(m)}
+
+
+_fn_wsc = _lib.galah_window_survivor_counts
+_fn_wsc.restype = None
+_fn_wsc.argtypes = [
+    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
+    ctypes.c_int64, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+]
+
+_fn_fcw = _lib.galah_fill_compact_windows
+_fn_fcw.restype = None
+_fn_fcw.argtypes = [
+    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
+    ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint64),
+]
+
+
+def compact_windows(flat: np.ndarray, n_windows: int, fraglen: int,
+                    k: int) -> np.ndarray:
+    """Compacted (W, slots) positional-hash windows from a flat
+    SENTINEL-masked array — C twin of the subsample_c > 1 branch of
+    fragment_ani.GenomeProfile.windows() (two streaming passes instead
+    of a full stable argsort). Bit-identical layout: survivors to the
+    front in order, boundary-crossing k-mers dropped, slots = the
+    longest row's count rounded up to a multiple of 64 (min 64)."""
+    flat = np.ascontiguousarray(flat, dtype=np.uint64)
+    if flat.shape[0] > n_windows * fraglen:
+        # the numpy twin fails loudly on inconsistent sizing; the C
+        # walk would write past counts/wins instead
+        raise ValueError(
+            f"flat length {flat.shape[0]} exceeds n_windows*fraglen "
+            f"{n_windows}*{fraglen}")
+    counts = np.empty(max(n_windows, 1), dtype=np.int64)
+    _fn_wsc(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        flat.shape[0], n_windows, fraglen, int(k),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    slots = max(int(counts[:n_windows].max()) if n_windows else 1, 1)
+    # the numpy twin slices its (W, L) array to `slots` columns, so
+    # the effective width can never exceed L
+    slots = min(-(-slots // 64) * 64, fraglen)
+    wins = np.full((n_windows, slots), np.uint64(SENTINEL),
+                   dtype=np.uint64)
+    _fn_fcw(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        flat.shape[0], n_windows, fraglen, int(k), slots,
+        wins.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    return wins

@@ -281,3 +281,40 @@ print("OK", len(a))
                           cwd=repo, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "OK" in proc.stdout
+
+
+def test_compact_windows_matches_numpy_layout():
+    """The two-pass C window compaction must reproduce the numpy
+    stable-argsort layout bit-for-bit (same slots, same order, same
+    boundary-k-mer drops) across ragged tails and densities."""
+    import numpy as np
+
+    from galah_tpu.ops import _cpairstats
+    from galah_tpu.ops.constants import SENTINEL
+
+    rng = np.random.default_rng(51)
+    for trial in range(10):
+        L = int(rng.integers(8, 200))
+        k = int(rng.integers(2, min(L, 32)))
+        n_flat = int(rng.integers(1, 6 * L))
+        w = -(-n_flat // L)
+        flat = rng.integers(0, 1 << 62, size=n_flat, dtype=np.uint64)
+        # subsample-style masking at random density
+        keep = rng.random(n_flat) < rng.uniform(0.02, 0.4)
+        flat = np.where(keep, flat, np.uint64(SENTINEL))
+
+        # numpy reference: the subsample_c > 1 branch of windows()
+        pad = np.full(w * L, np.uint64(SENTINEL), dtype=np.uint64)
+        pad[:n_flat] = flat
+        wins = pad.reshape(w, L).copy()
+        wins[:, L - (k - 1):] = np.uint64(SENTINEL)
+        order = np.argsort(wins == np.uint64(SENTINEL), axis=1,
+                           kind="stable")
+        wins = np.take_along_axis(wins, order, axis=1)
+        counts = (wins != np.uint64(SENTINEL)).sum(axis=1)
+        slots = max(int(counts.max()) if counts.size else 1, 1)
+        slots = -(-slots // 64) * 64
+        want = wins[:, :slots].copy()
+
+        got = _cpairstats.compact_windows(flat, w, L, k)
+        np.testing.assert_array_equal(got, want)

@@ -170,7 +170,9 @@ def test_generic_batch_path_matches_c_path(tmp_path):
     this pins the generic path explicitly against it (regression
     coverage the auto-routing otherwise removes)."""
     import numpy as np
+    import pytest
 
+    pytest.importorskip("galah_tpu.ops._csketch")
     from galah_tpu.io.fasta import Genome, GenomeStats
     from galah_tpu.ops import fragment_ani as fa
 
