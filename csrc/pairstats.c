@@ -302,3 +302,23 @@ void galah_fill_compact_windows(const uint64_t *flat, int64_t n_flat,
     (void)W;
     (void)SENT;
 }
+
+/* Sorted-merge membership counter — the per-pair fast path of the
+ * fragment-ANI membership test. The matrix walker above pays
+ * O(valid_slots * log H) binary searches per pair; with the query's
+ * surviving hashes pre-sorted once per profile (cached host-side),
+ * one linear merge against the sorted distinct ref set costs
+ * O(nq + H) per pair. matched must be zeroed by the caller; totals
+ * are pair-independent (per-window valid counts) and are computed by
+ * the caller once per profile. Bit-identical matched counts to
+ * galah_window_match_counts on the same windows. */
+void galah_window_match_counts_merge(
+    const uint64_t *qh, const int32_t *qw, int64_t nq,
+    const uint64_t *ref, int64_t H, int32_t *matched) {
+    int64_t r = 0;
+    for (int64_t i = 0; i < nq; i++) {
+        uint64_t h = qh[i];
+        while (r < H && ref[r] < h) r++;
+        if (r < H && ref[r] == h) matched[qw[i]]++;
+    }
+}

@@ -199,3 +199,41 @@ def compact_windows(flat: np.ndarray, n_windows: int, fraglen: int,
         flat.shape[0], n_windows, fraglen, int(k), slots,
         wins.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
     return wins
+
+
+_fn_wmm = _lib.galah_window_match_counts_merge
+_fn_wmm.restype = None
+_fn_wmm.argtypes = [
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
+    ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int32),
+]
+
+
+def window_match_counts_merge(
+        qh: np.ndarray, qw: np.ndarray, n_windows: int,
+        ref_set: np.ndarray, validate: bool = True) -> np.ndarray:
+    """Per-window matched counts via one linear merge of the profile's
+    pre-sorted surviving hashes against the sorted distinct ref set —
+    bit-identical to window_match_counts' matched output. qh must be
+    sorted ascending with qw its window ids. Pass validate=False only
+    when the arrays come from a source that already guarantees the
+    bounds (GenomeProfile.sorted_query) — the check is two O(nq) scans,
+    which would otherwise repeat per pair on the hot path."""
+    qh = np.ascontiguousarray(qh, dtype=np.uint64)
+    qw = np.ascontiguousarray(qw, dtype=np.int32)
+    ref_set = np.ascontiguousarray(ref_set, dtype=np.uint64)
+    if qh.shape != qw.shape:
+        raise ValueError("qh/qw shape mismatch")
+    if validate and qw.shape[0] and (qw.min() < 0
+                                     or qw.max() >= n_windows):
+        raise ValueError("window id out of range")
+    matched = np.zeros(n_windows, dtype=np.int32)
+    _fn_wmm(
+        qh.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        qw.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        qh.shape[0],
+        ref_set.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ref_set.shape[0],
+        matched.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return matched

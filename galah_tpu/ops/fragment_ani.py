@@ -74,10 +74,30 @@ class GenomeProfile:
     _np_ref_padded: Optional[np.ndarray] = None
     # unpadded windows, cached for the C membership fast path
     _np_windows: Optional[np.ndarray] = None
+    # (sorted hashes, their window ids, per-window totals) — cached for
+    # the C merge membership fast path; totals are pair-independent
+    _np_sorted_query: "Optional[tuple]" = None
 
     @property
     def n_windows(self) -> int:
         return -(-self.flat_hashes.shape[0] // self.fraglen)
+
+    def sorted_query(self) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
+        """(qh, qw, totals): the profile's surviving window hashes
+        sorted ascending, their window row ids, and each window's
+        valid-hash count. Built once from windows() and cached — the
+        merge membership path (csrc/pairstats.c::
+        galah_window_match_counts_merge) consumes it per pair."""
+        if self._np_sorted_query is None:
+            wins = self.windows()
+            mask = wins != np.uint64(SENTINEL)
+            totals = mask.sum(axis=1, dtype=np.int32)
+            rows, _cols = np.nonzero(mask)
+            qh = wins[mask]
+            order = np.argsort(qh)
+            self._np_sorted_query = (
+                qh[order], rows[order].astype(np.int32), totals)
+        return self._np_sorted_query
 
     def padded_windows(self) -> np.ndarray:
         if self._np_windows_padded is None:
@@ -445,23 +465,36 @@ def directed_ani_batch(
     src/fastani.rs:88-105) — and the reason the engine's backend
     interface is batched (see backends/base.py).
     """
-    # Single-device CPU backend: the compiled-C membership counter
-    # (csrc/pairstats.c::galah_window_match_counts) beats the XLA-CPU
-    # searchsorted dispatch per pair and needs no padding. Multi-device
-    # runtimes keep the sharded vmapped path.
+    # Single-device CPU backend: the compiled-C merge membership
+    # counter (csrc/pairstats.c::galah_window_match_counts_merge —
+    # O(nq + H) per pair on the profile's cached sorted query, vs the
+    # matrix walker's O(slots * log H) binary searches) beats the
+    # XLA-CPU searchsorted dispatch per pair and needs no padding.
+    # Multi-device runtimes keep the sharded vmapped path.
     if jax.default_backend() == "cpu" and jax.device_count() == 1:
         try:
-            from galah_tpu.ops._cpairstats import window_match_counts
+            from galah_tpu.ops._cpairstats import (
+                window_match_counts_merge,
+            )
         except ImportError:
-            window_match_counts = None  # no C toolchain: JAX path
-        if window_match_counts is not None:
-            return [
-                _directed_from_counts(
-                    *window_match_counts(q.windows(), r.ref_set,
-                                         threads=threads),
-                    q, identity_floor, min_window_valid_frac)
-                for q, r in queries
-            ]
+            window_match_counts_merge = None  # no C toolchain: JAX
+        if window_match_counts_merge is not None:
+            def one(pair):
+                q, r = pair
+                qh, qw, totals = q.sorted_query()
+                matched = window_match_counts_merge(
+                    qh, qw, q.n_windows, r.ref_set, validate=False)
+                return _directed_from_counts(
+                    matched, totals, q, identity_floor,
+                    min_window_valid_frac)
+
+            if threads > 1 and len(queries) > 1:
+                # pairs are independent and the merge releases the GIL
+                # (ctypes) — honor the threads knob across pairs
+                from galah_tpu.io.prefetch import _shared_pool
+
+                return list(_shared_pool(threads).map(one, queries))
+            return [one(pair) for pair in queries]
 
     out: "list[Optional[DirectedANI]]" = [None] * len(queries)
     groups: "dict[tuple, list[int]]" = {}

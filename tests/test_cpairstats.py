@@ -318,3 +318,36 @@ def test_compact_windows_matches_numpy_layout():
 
         got = _cpairstats.compact_windows(flat, w, L, k)
         np.testing.assert_array_equal(got, want)
+
+
+def test_window_match_counts_merge_parity():
+    """The sorted-merge membership counter must reproduce the matrix
+    walker's matched counts exactly (and the profile totals must match
+    its total output) across densities, duplicates, and empty edges."""
+    import numpy as np
+
+    from galah_tpu.ops import _cpairstats
+    from galah_tpu.ops.constants import SENTINEL
+
+    rng = np.random.default_rng(52)
+    for trial in range(10):
+        W = int(rng.integers(1, 40))
+        slots = int(rng.integers(1, 80))
+        wins = rng.integers(0, 200, size=(W, slots)).astype(np.uint64)
+        kill = rng.random((W, slots)) < rng.uniform(0.1, 0.9)
+        wins[kill] = np.uint64(SENTINEL)
+        ref = np.unique(
+            rng.integers(0, 200, size=int(rng.integers(1, 150)))
+        ).astype(np.uint64)
+
+        want_m, want_t = _cpairstats.window_match_counts(wins, ref)
+
+        mask = wins != np.uint64(SENTINEL)
+        totals = mask.sum(axis=1, dtype=np.int32)
+        rows, _ = np.nonzero(mask)
+        qh = wins[mask]
+        order = np.argsort(qh)
+        got_m = _cpairstats.window_match_counts_merge(
+            qh[order], rows[order].astype(np.int32), W, ref)
+        np.testing.assert_array_equal(got_m, want_m)
+        np.testing.assert_array_equal(totals, want_t)
