@@ -296,7 +296,8 @@ def generate_galah_clusterer(
         precluster_ani = ani
 
     store = ProfileStore(fraglen=fraglen, cache=cache,
-                         subsample_c=ani_subsample, threads=threads)
+                         subsample_c=ani_subsample, threads=threads,
+                         hash_algorithm=hash_algo)
     if pre_method == "finch":
         pre = MinHashPreclusterer(min_ani=precluster_ani, cache=cache,
                                   hash_algo=hash_algo, threads=threads)

@@ -62,10 +62,12 @@ class ProfileStore:
                  maxsize: int = 128,
                  cache: Optional[diskcache.CacheDir] = None,
                  subsample_c: int = Defaults.ANI_SUBSAMPLE,
-                 threads: int = 1) -> None:
+                 threads: int = 1,
+                 hash_algorithm: str = "murmur3") -> None:
         self.k = k
         self.fraglen = fraglen
         self.subsample_c = int(subsample_c)
+        self.hash_algorithm = hash_algorithm
         self.threads = max(int(threads), 1)
         self.maxsize = maxsize
         self.disk = cache or diskcache.get_cache()
@@ -74,10 +76,12 @@ class ProfileStore:
 
     def _params(self) -> dict:
         p = {"k": self.k, "fraglen": self.fraglen}
-        # only key the cache on subsample_c when it is active, so
-        # default-path entries from before the flag existed stay valid
+        # only key the cache on non-default knobs, so default-path
+        # entries from before each flag existed stay valid
         if self.subsample_c != 1:
             p["subsample_c"] = self.subsample_c
+        if self.hash_algorithm != "murmur3":
+            p["hash_algorithm"] = self.hash_algorithm
         return p
 
     @contextlib.contextmanager
@@ -127,7 +131,8 @@ class ProfileStore:
         if prof is None:
             prof = fragment_ani.build_profile(
                 read_genome(path), k=self.k, fraglen=self.fraglen,
-                subsample_c=self.subsample_c)
+                subsample_c=self.subsample_c,
+                hash_algorithm=self.hash_algorithm)
             self._store_disk(path, prof)
         self._insert(path, prof)
         return prof
@@ -162,10 +167,12 @@ class ProfileStore:
                 fragment_ani.PROFILE_BATCH_BUDGET,
                 lambda buf: fragment_ani.build_profiles_batch(
                     [g for _, g in buf], k=self.k, fraglen=self.fraglen,
-                    subsample_c=self.subsample_c),
+                    subsample_c=self.subsample_c,
+                    hash_algorithm=self.hash_algorithm),
                 lambda _path, g: fragment_ani.build_profile(
                     g, k=self.k, fraglen=self.fraglen,
-                    subsample_c=self.subsample_c),
+                    subsample_c=self.subsample_c,
+                    hash_algorithm=self.hash_algorithm),
                 batched=device_transfer_bound(),
                 workers=self.threads):
             self._store_disk(p, prof)

@@ -119,6 +119,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="FracMinHash compression of the exact ANI "
                         "re-check (see `cluster --full-help`; "
                         "default: 1)")
+    v.add_argument("--hash-algorithm", default=Defaults.HASH_ALGO,
+                   choices=sorted(HASH_ALGORITHMS),
+                   help="k-mer hash for the validation profiles — use "
+                        "the same value the clustering ran with so "
+                        "near-threshold pairs score identically "
+                        "(default: murmur3)")
     v.add_argument("--threads", "-t", type=int, default=1)
 
     dd = sub.add_parser(
@@ -339,7 +345,10 @@ def run_cluster_validate(args) -> int:
         threshold=ani, min_aligned_fraction=min_af,
         fraglen=args.fragment_length,
         store=ProfileStore(fraglen=args.fragment_length,
-                           subsample_c=subsample))
+                           subsample_c=subsample,
+                           hash_algorithm=getattr(
+                               args, "hash_algorithm",
+                               Defaults.HASH_ALGO)))
     validate_clusters(args.cluster_file, clusterer)
     return 0
 

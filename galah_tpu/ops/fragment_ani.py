@@ -178,7 +178,8 @@ PROFILE_BATCH_BUDGET = hashing.BATCH_BUDGET // 2
 
 
 def positional_hashes(genome: Genome, k: int,
-                      chunk: int = hashing.DEFAULT_CHUNK) -> np.ndarray:
+                      chunk: int = hashing.DEFAULT_CHUNK,
+                      algo: str = "murmur3") -> np.ndarray:
     """All canonical k-mer hashes of a genome in genome order (device).
 
     On a single-process CPU backend the compiled-C walker
@@ -195,18 +196,20 @@ def positional_hashes(genome: Genome, k: int,
             from galah_tpu.ops import _csketch
 
             return _csketch.positional_hashes(
-                genome.codes, genome.contig_offsets, k=k)
+                genome.codes, genome.contig_offsets, k=k, algo=algo)
         except ImportError:
             pass  # no C toolchain: fall through to the JAX path
     out = np.empty(n - k + 1, dtype=np.uint64)
     for h, pos, n_new in hashing.iter_chunk_hashes(
-            genome.codes, genome.contig_offsets, k=k, chunk=chunk):
+            genome.codes, genome.contig_offsets, k=k, chunk=chunk,
+            algo=algo):
         out[pos: pos + n_new] = np.asarray(h)[:n_new]
     return out
 
 
 def positional_hashes_batch(genomes, k: int,
-                            budget: int = PROFILE_BATCH_BUDGET) -> list:
+                            budget: int = PROFILE_BATCH_BUDGET,
+                            algo: str = "murmur3") -> list:
     """Batch twin of positional_hashes: grouped one-dispatch hashing of
     many genomes (same grouping as ops/minhash batch sketching), each
     entry bit-identical to positional_hashes(genome, k)."""
@@ -214,13 +217,13 @@ def positional_hashes_batch(genomes, k: int,
     skipped, group_iter = hashing.iter_genome_groups(
         genomes, budget=budget, max_len=hashing.DEFAULT_CHUNK)
     for i in skipped:
-        out[i] = positional_hashes(genomes[i], k)
+        out[i] = positional_hashes(genomes[i], k, algo=algo)
     for chunk_idxs, packed, ambits, offs in group_iter:
         import jax.numpy as jnp
 
         h = np.asarray(hashing.canonical_kmer_hashes_batch_jit(
             jnp.asarray(packed), jnp.asarray(ambits), jnp.asarray(offs),
-            k=k))
+            k=k, algo=algo))
         for row, gi in enumerate(chunk_idxs):
             n = genomes[gi].codes.shape[0]
             if n < k:
@@ -275,7 +278,8 @@ def _c_profile_available(k: int) -> bool:
 
 
 def _profile_via_c(genome: Genome, k: int, fraglen: int,
-                   subsample_c: int) -> GenomeProfile:
+                   subsample_c: int,
+                   algo: str = "murmur3") -> GenomeProfile:
     """Single-pass C profile build: hash walk + FracMinHash mask +
     valid compaction in one sweep (csrc/sketch.c::
     galah_positional_hashes_masked), leaving only a small np.unique on
@@ -286,13 +290,14 @@ def _profile_via_c(genome: Genome, k: int, fraglen: int,
 
     cut = 0 if subsample_c == 1 else (1 << 64) // subsample_c
     flat, valid = _csketch.positional_hashes_masked(
-        genome.codes, genome.contig_offsets, k=k, cut=cut)
+        genome.codes, genome.contig_offsets, k=k, cut=cut, algo=algo)
     return _finish_profile(genome.path, flat, valid, k, fraglen,
                            subsample_c)
 
 
 def build_profile(genome: Genome, k: int, fraglen: int,
-                  subsample_c: int = 1) -> GenomeProfile:
+                  subsample_c: int = 1,
+                  hash_algorithm: str = "murmur3") -> GenomeProfile:
     """Profile a genome for fragment ANI.
 
     With subsample_c > 1 only k-mers whose hash falls below 2^64/c are
@@ -308,13 +313,16 @@ def build_profile(genome: Genome, k: int, fraglen: int,
     """
     _check_subsample(subsample_c)  # fail before any device hashing
     if _c_profile_available(k):
-        return _profile_via_c(genome, k, fraglen, subsample_c)
-    return _profile_from_flat(genome.path, positional_hashes(genome, k),
-                              k, fraglen, subsample_c)
+        return _profile_via_c(genome, k, fraglen, subsample_c,
+                              algo=hash_algorithm)
+    return _profile_from_flat(
+        genome.path, positional_hashes(genome, k, algo=hash_algorithm),
+        k, fraglen, subsample_c)
 
 
 def build_profiles_batch(genomes, k: int, fraglen: int,
-                         subsample_c: int = 1) -> list:
+                         subsample_c: int = 1,
+                         hash_algorithm: str = "murmur3") -> list:
     """Batch twin of build_profile: one hash dispatch per genome group
     instead of per genome (reference analog: skani's fastx_to_sketches
     over all files, src/skani.rs:46)."""
@@ -323,9 +331,10 @@ def build_profiles_batch(genomes, k: int, fraglen: int,
         # CPU backend with the C walker: per-genome single-pass builds
         # beat device batch grouping (no dispatch round trips to
         # amortize).
-        return [_profile_via_c(g, k, fraglen, subsample_c)
+        return [_profile_via_c(g, k, fraglen, subsample_c,
+                               algo=hash_algorithm)
                 for g in genomes]
-    flats = positional_hashes_batch(genomes, k)
+    flats = positional_hashes_batch(genomes, k, algo=hash_algorithm)
     return [
         _profile_from_flat(g.path, flat, k, fraglen, subsample_c)
         for g, flat in zip(genomes, flats)
@@ -490,9 +499,14 @@ def directed_ani_batch(
 
             if threads > 1 and len(queries) > 1:
                 # pairs are independent and the merge releases the GIL
-                # (ctypes) — honor the threads knob across pairs
+                # (ctypes) — honor the threads knob across pairs. Warm
+                # each unique query's sorted_query cache first so the
+                # first wave of threads doesn't build it redundantly
+                # (one candidate vs many refs is the common shape).
                 from galah_tpu.io.prefetch import _shared_pool
 
+                for q in {id(q): q for q, _ in queries}.values():
+                    q.sorted_query()
                 return list(_shared_pool(threads).map(one, queries))
             return [one(pair) for pair in queries]
 

