@@ -57,9 +57,27 @@ class PairDistanceCache:
 
         `indices` must be sorted ascending (precluster members are);
         mirrors reference src/sorted_pair_genome_distance_cache.rs:47-58.
+
+        Cost: min(m^2/2 probes, one full-cache scan) — the greedy
+        engine calls this once per precluster, and scanning the whole
+        cache each time measured 22.7 s of a 40k-genome run (10k
+        preclusters x 150k cached pairs); typical preclusters have a
+        handful of members, so probing their own pairs wins by orders
+        of magnitude, while near-duplicate mega-preclusters keep the
+        scan path.
         """
-        remap = {g: l for l, g in enumerate(indices)}
         out = PairDistanceCache()
+        m = len(indices)
+        missing = object()
+        if m * (m - 1) // 2 < len(self._d):
+            for a in range(m):
+                gi = indices[a]
+                for b in range(a + 1, m):
+                    v = self._d.get(pair_key(gi, indices[b]), missing)
+                    if v is not missing:
+                        out.insert((a, b), v)
+            return out
+        remap = {g: l for l, g in enumerate(indices)}
         for (i, j), v in self._d.items():
             if i in remap and j in remap:
                 out.insert((remap[i], remap[j]), v)

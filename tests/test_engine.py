@@ -262,3 +262,34 @@ def test_warm_pass_waste_is_counted():
                 - before.get("exact-ani-computed", 0))
     # every hit pair was warmed upfront: n*(n-1)/2
     assert computed == n * (n - 1) // 2
+
+
+def test_transform_ids_probe_and_scan_branches_agree():
+    """transform_ids picks probe-vs-scan by size; both must agree,
+    including stored-None values and duplicate-free remapping."""
+    import numpy as np
+
+    from galah_tpu.cluster.cache import PairDistanceCache
+
+    rng = np.random.default_rng(81)
+    cache = PairDistanceCache()
+    for _ in range(300):
+        i, j = map(int, rng.integers(0, 60, size=2))
+        if i == j:
+            continue
+        v = None if rng.random() < 0.2 else float(rng.random())
+        cache.insert((i, j), v)
+    # m=2/4/9 take the probe branch (m^2/2 < cache size), m=40 takes
+    # the scan branch (780 candidate pairs > ~260 cached); the oracle
+    # below is branch-independent (contains/get per candidate pair),
+    # so both branches are checked against the same contract.
+    for m in (2, 4, 9, 40):
+        indices = sorted(map(int, rng.choice(60, size=m, replace=False)))
+        got = cache.transform_ids(indices)
+        want = PairDistanceCache()
+        for a in range(m):
+            for b in range(a + 1, m):
+                if cache.contains((indices[a], indices[b])):
+                    want.insert((a, b),
+                                cache.get((indices[a], indices[b])))
+        assert got == want
