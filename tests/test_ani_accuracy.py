@@ -107,21 +107,25 @@ def test_unrelated_genomes_measure_no_ani():
     assert ab.frags_matching == 0 and ba.frags_matching == 0
 
 
+@pytest.mark.parametrize("algo", ["murmur3", "tpufast"])
 @pytest.mark.parametrize("c", [16, 125])
-def test_subsampled_ani_tracks_planted_rate(c):
+def test_subsampled_ani_tracks_planted_rate(c, algo):
     """FracMinHash subsampling (--ani-subsample) must keep the measured
     ANI within 0.5pp of the planted rate — the accuracy class of the
     reference's skani, which runs at c=125 (reference:
-    src/skani.rs:159-161)."""
+    src/skani.rs:159-161). Both profile hashes must hold the bound
+    (--hash-algorithm selects the fragment-profile hash too)."""
     rng = np.random.default_rng(c)
     base = rng.integers(0, 4, size=L).astype(np.uint8)
     mut, n_sites = _mutate(base, 0.03, rng)
     planted = 1.0 - n_sites / L
 
     pa = fragment_ani.build_profile(_genome(base, "a"), k=K,
-                                    fraglen=3000, subsample_c=c)
+                                    fraglen=3000, subsample_c=c,
+                                    hash_algorithm=algo)
     pb = fragment_ani.build_profile(_genome(mut, "b"), k=K,
-                                    fraglen=3000, subsample_c=c)
+                                    fraglen=3000, subsample_c=c,
+                                    hash_algorithm=algo)
     ani, ab, ba = fragment_ani.bidirectional_ani(
         pa, pb, min_aligned_frac=0.15)
     assert ani is not None
