@@ -175,8 +175,11 @@ static void bk_compact(bk_acc *acc) {
 }
 
 static inline void bk_add(bk_acc *acc, uint64_t h) {
-    if (h >= acc->thr) return;
-    acc->cand[acc->n_cand++] = h;
+    /* branchless admission: always write, conditionally advance —
+     * the data-dependent h >= thr branch mispredicts heavily on the
+     * pre-threshold prefix of every genome */
+    acc->cand[acc->n_cand] = h;
+    acc->n_cand += (h < acc->thr);
     if (acc->n_cand >= acc->cap - acc->size) bk_compact(acc);
 }
 
