@@ -508,19 +508,44 @@ def main():
     except Exception as e:  # noqa: BLE001
         errors.append(f"pairwise_xla: {type(e).__name__}: {e}")
 
+    # 4b. Amortized ON-CHIP kernel throughput (device-resident inputs,
+    # fori_loop repeats inside one dispatch): the MFU measurement that
+    # separates kernel speed from tunnel dispatch/transfer. Subprocess
+    # so a wedge mid-campaign cannot take down the bench line.
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "scripts", "bench_amortized.py"),
+             "--fast"],
+            capture_output=True, text=True, timeout=900, cwd=here)
+        amort = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("AMORTIZED_JSON "):
+                amort = json.loads(line[len("AMORTIZED_JSON "):])
+        if amort is None:
+            raise RuntimeError(
+                f"rc={proc.returncode}: {proc.stderr[-400:]}")
+        stages["amortized_on_chip"] = amort
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"amortized: {type(e).__name__}: {e}")
+
     # 5. Sketching throughput on real FASTA bytes, both hash algos —
     # each with its own watchdog so one failure never loses the other.
+    # 600 s: a cold tunnel session compiles every chunk-bucket variant
+    # at 20-40 s each, which is what timed the round-3 capture out at
+    # 240 s — the budget must cover compiles, not just compute.
     for algo, key in (("murmur3", "sketch_bp_per_sec"),
                       ("tpufast", "sketch_tpufast_bp_per_sec")):
         try:
-            with watchdog(240):
+            with watchdog(600):
                 bps = bench_sketching(algo)
                 if bps:
                     stages[key] = round(bps, 1)
         except Exception as e:  # noqa: BLE001
             errors.append(f"sketching-{algo}: {type(e).__name__}: {e}")
     try:
-        with watchdog(240):
+        with watchdog(600):
             bps = bench_sketching_batch("murmur3")
             if bps:
                 stages["sketch_batch_bp_per_sec"] = round(bps, 1)

@@ -287,12 +287,22 @@ int64_t galah_collision_pair_counts(
         }
     }
 
-    /* LSB radix sort, 4 passes x 16 bits */
+    /* LSB radix sort, 4 passes x 16 bits. The 512 KiB histogram is
+     * heap-allocated: this pass can run on worker threads, whose
+     * stacks may be far smaller than the main thread's (e.g. musl's
+     * 128 KiB default). */
     static const int RADIX_BITS = 16;
-    int64_t hist[1 << 16];
+    int64_t *hist = (int64_t *)malloc((1 << 16) * sizeof(int64_t));
+    if (!hist) {
+        free(k0);
+        free(k1);
+        free(p0);
+        free(p1);
+        return -1;
+    }
     for (int pass = 0; pass < 4; pass++) {
         int shift = pass * RADIX_BITS;
-        memset(hist, 0, sizeof(hist));
+        memset(hist, 0, (1 << 16) * sizeof(int64_t));
         for (int64_t i = 0; i < m; i++)
             hist[(k0[i] >> shift) & 0xFFFF]++;
         int64_t acc = 0;
@@ -313,6 +323,7 @@ int64_t galah_collision_pair_counts(
         p0 = p1;
         p1 = tp;
     }
+    free(hist);
     free(k1);
     free(p1);
 

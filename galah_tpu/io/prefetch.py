@@ -48,6 +48,20 @@ def _shared_pool(workers: int) -> ThreadPoolExecutor:
         return _POOL
 
 
+def _settle(futures) -> None:
+    """Cancel queued look-ahead futures and wait out already-running
+    ones, so an abandoned generator (close/GeneratorExit/exception)
+    leaves no load_fn racing with the caller's cleanup — e.g. a
+    temp-dir removal after the exception that abandoned the stream."""
+    running = [f for f in futures if not f.cancel()]
+    for f in running:
+        # exception() waits for completion and RETURNS the worker's
+        # error instead of raising it (the consumer is gone; nothing to
+        # surface it to) — while an ambient KeyboardInterrupt delivered
+        # to THIS thread still propagates rather than being swallowed.
+        f.exception()
+
+
 def probe_and_prefetch(
     paths: Sequence[str],
     probe: Callable[[str], "V | None"],
@@ -135,14 +149,17 @@ def process_stream(
             pending.append((p, pool.submit(single_fn, p, item)))
             return True
 
-        for _ in range(2 * workers):
-            if not submit_next():
-                break
-        while pending:
-            p, fut = pending.popleft()
-            result = fut.result()
-            submit_next()
-            yield p, result
+        try:
+            for _ in range(2 * workers):
+                if not submit_next():
+                    break
+            while pending:
+                p, fut = pending.popleft()
+                result = fut.result()
+                submit_next()
+                yield p, result
+        finally:
+            _settle(fut for _, fut in pending)
     else:
         for p, it_ in items:
             yield p, single_fn(p, it_)
@@ -161,11 +178,14 @@ def iter_prefetched(
         return
     pool = _shared_pool(depth)
     pending = []
-    for idx in range(min(depth, len(paths))):
-        pending.append(pool.submit(load_fn, paths[idx]))
-    for i, path in enumerate(paths):
-        fut = pending.pop(0)
-        nxt = i + depth
-        if nxt < len(paths):
-            pending.append(pool.submit(load_fn, paths[nxt]))
-        yield path, fut.result()
+    try:
+        for idx in range(min(depth, len(paths))):
+            pending.append(pool.submit(load_fn, paths[idx]))
+        for i, path in enumerate(paths):
+            fut = pending.pop(0)
+            nxt = i + depth
+            if nxt < len(paths):
+                pending.append(pool.submit(load_fn, paths[nxt]))
+            yield path, fut.result()
+    finally:
+        _settle(pending)

@@ -114,6 +114,10 @@ def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,
     if (n >= sparse_screen_min_n()
             and not os.environ.get("GALAH_TPU_DENSE_PAIRS")):
         pi, pj = _candidate_pairs_sparse(mat, lens, j_thr, sketch_size)
+        from galah_tpu.utils import timing
+
+        timing.counter("screen-candidates", int(pi.shape[0]))
+        timing.counter("screen-possible-pairs", n * (n - 1) // 2)
         out_ani = np.empty(pi.shape[0], dtype=np.float64)
         if pi.shape[0]:
             _fn_pl(

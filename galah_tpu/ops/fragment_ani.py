@@ -44,6 +44,7 @@ import numpy as np
 from galah_tpu.io.fasta import Genome
 from galah_tpu.ops import hashing
 from galah_tpu.ops.constants import SENTINEL
+from galah_tpu.utils import timing
 
 MARKER_C = 1000  # FracMinHash compression for screening markers
                  # (reference: src/skani.rs:158 "let m = 1000")
@@ -203,6 +204,8 @@ def positional_hashes(genome: Genome, k: int,
     for h, pos, n_new in hashing.iter_chunk_hashes(
             genome.codes, genome.contig_offsets, k=k, chunk=chunk,
             algo=algo):
+        timing.dispatch()
+        timing.dispatch(sync=True)
         out[pos: pos + n_new] = np.asarray(h)[:n_new]
     return out
 
@@ -221,6 +224,8 @@ def positional_hashes_batch(genomes, k: int,
     for chunk_idxs, packed, ambits, offs in group_iter:
         import jax.numpy as jnp
 
+        timing.dispatch()
+        timing.dispatch(sync=True)
         h = np.asarray(hashing.canonical_kmer_hashes_batch_jit(
             jnp.asarray(packed), jnp.asarray(ambits), jnp.asarray(offs),
             k=k, algo=algo))
@@ -507,7 +512,37 @@ def directed_ani_batch(
 
                 for q in {id(q): q for q, _ in queries}.values():
                     q.sorted_query()
-                return list(_shared_pool(threads).map(one, queries))
+                # The shared pool is sized to the LARGEST worker count
+                # ever requested in-process; keep at most `threads`
+                # futures outstanding so a smaller knob here still
+                # bounds concurrency to what the user asked for, and
+                # refill on EACH completion (not in waves — pair costs
+                # are heterogeneous, one big query vs many small refs
+                # is the common shape).
+                from concurrent.futures import FIRST_COMPLETED, wait
+
+                pool = _shared_pool(threads)
+                out: "list[Optional[DirectedANI]]" = [None] * len(queries)
+                it = iter(enumerate(queries))
+                pending = {}
+
+                def submit_next() -> bool:
+                    try:
+                        i, pair = next(it)
+                    except StopIteration:
+                        return False
+                    pending[pool.submit(one, pair)] = i
+                    return True
+
+                for _ in range(threads):
+                    if not submit_next():
+                        break
+                while pending:
+                    done, _ = wait(pending, return_when=FIRST_COMPLETED)
+                    for f in done:
+                        out[pending.pop(f)] = f.result()
+                        submit_next()
+                return out  # type: ignore[return-value]
             return [one(pair) for pair in queries]
 
     out: "list[Optional[DirectedANI]]" = [None] * len(queries)
@@ -523,6 +558,8 @@ def directed_ani_batch(
         b_max = max(1, _BATCH_ELEM_CAP // max(per_query_elems, 1))
         for start in range(0, len(idxs), b_max):
             chunk = idxs[start:start + b_max]
+            timing.dispatch()
+            timing.dispatch(sync=True)
             if len(chunk) == 1:
                 n = chunk[0]
                 q, r = queries[n]

@@ -32,6 +32,27 @@ from galah_tpu.ops.constants import SENTINEL
 _C1 = jnp.uint64(0x87C37B91114253D5)
 _C2 = jnp.uint64(0x4CF5AD432745937F)
 
+# Mosaic murmur3 state-machine default on TPU backends when
+# GALAH_TPU_PALLAS_HASH is unset. Set from hardware data ONLY: the
+# amortized on-chip campaign (scripts/bench_amortized.py, murmur
+# verdict row) flips this to True if the Mosaic kernel beats the XLA
+# emulation >= 1.1x on-chip. Tunnel-bound measurements (round 3:
+# 1.00x, dispatch-bound) do not qualify.
+_PALLAS_HASH_TPU_DEFAULT = False
+
+
+def _use_pallas_hash() -> bool:
+    """GALAH_TPU_PALLAS_HASH: '1' forces the Mosaic hash kernel, '0'
+    forces the XLA emulation; unset defers to the data-driven TPU
+    default above (never on for CPU backends — interpret mode is for
+    tests that pin it explicitly)."""
+    env = os.environ.get("GALAH_TPU_PALLAS_HASH")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return _PALLAS_HASH_TPU_DEFAULT and jax.default_backend() == "tpu"
+
 HASH_SENTINEL = jnp.uint64(SENTINEL)  # "no k-mer here"
 
 # Chunking policy shared by every consumer of iter_chunk_hashes /
@@ -301,7 +322,7 @@ def _hash_core(
             # the enclosing jit — set before first use, or
             # jax.clear_caches()); interpret mode keeps the opt-in
             # exercisable on CPU backends.
-            if os.environ.get("GALAH_TPU_PALLAS_HASH") == "1":
+            if _use_pallas_hash():
                 from galah_tpu.ops.pallas_sketch import (
                     assemble_k21_words,
                     murmur3_k21_pallas,

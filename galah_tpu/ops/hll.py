@@ -33,6 +33,7 @@ import numpy as np
 
 from galah_tpu.io.fasta import Genome
 from galah_tpu.ops import hashing
+from galah_tpu.utils import timing
 
 DEFAULT_P = 12  # 4096 registers: ~1.6% cardinality std error, 4 KiB/genome
 
@@ -93,6 +94,8 @@ def hll_sketch_genome(
             genome.codes, genome.contig_offsets, k=k, chunk=chunk,
             seed=seed, algo=algo):
         regs = _hll_update(regs, hashes, p)
+        timing.dispatch()
+    timing.dispatch(sync=True)
     return np.asarray(regs)
 
 
@@ -125,6 +128,8 @@ def hll_sketch_genomes_batch(
         out[i] = hll_sketch_genome(genomes[i], p=p, k=k, seed=seed,
                                    algo=algo)
     for chunk_idxs, packed, ambits, offs in group_iter:
+        timing.dispatch()
+        timing.dispatch(sync=True)
         regs = np.asarray(_batch_hll_kernel(
             jnp.asarray(packed), jnp.asarray(ambits), jnp.asarray(offs),
             p=p, k=k, seed=seed, algo=algo))
@@ -341,13 +346,17 @@ def _hll_threshold_single(
 
     from galah_tpu.ops.compact import iter_blocks
 
+    def run_block(r0, cap):
+        timing.dispatch()
+        return _hll_rowblock(
+            pow2, cards, jnp.int32(r0), jnp.float32(min_ani),
+            jnp.int32(n), k=k, row_tile=row_tile, col_tile=col_tile,
+            use_pallas=use_pallas, cap=cap)
+
     out: dict[Tuple[int, int], float] = {}
     for r0, (flat_idx, vals, count) in iter_blocks(
-            n, row_tile, cap_per_row,
-            lambda r0, cap: _hll_rowblock(
-                pow2, cards, jnp.int32(r0), jnp.float32(min_ani),
-                jnp.int32(n), k=k, row_tile=row_tile, col_tile=col_tile,
-                use_pallas=use_pallas, cap=cap)):
+            n, row_tile, cap_per_row, run_block):
+        timing.dispatch(sync=True)
         count = int(count)
         flat_idx = np.asarray(flat_idx)[:count]
         vals = np.asarray(vals)[:count]

@@ -21,6 +21,7 @@ from galah_tpu.io.fasta import Genome
 from galah_tpu.ops import hashing
 from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.minhash_np import MinHashSketch
+from galah_tpu.utils import timing
 
 # Chunk/budget policy lives with the chunk iterator (ops/hashing.py);
 # re-exported here for existing importers.
@@ -62,7 +63,9 @@ def sketch_genome_device(
             seed=seed, algo=algo):
         running = hashing.bottom_k_update(
             running, hashes, sketch_size=sketch_size)
+        timing.dispatch()
 
+    timing.dispatch(sync=True)
     out = np.asarray(running)
     out = out[out != np.uint64(SENTINEL)]
     return MinHashSketch(hashes=out, sketch_size=sketch_size, kmer=k)
@@ -112,6 +115,8 @@ def sketch_genomes_device_batch(
             genomes[i], sketch_size=sketch_size, k=k, seed=seed,
             algo=algo)
     for chunk_idxs, packed, ambits, offs in group_iter:
+        timing.dispatch()
+        timing.dispatch(sync=True)
         mat = np.asarray(_batch_sketch_kernel(
             jnp.asarray(packed), jnp.asarray(ambits),
             jnp.asarray(offs), k=k, seed=seed, algo=algo,

@@ -34,6 +34,7 @@ from jax import shard_map
 
 from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.hashing import HASH_SENTINEL
+from galah_tpu.utils import timing
 
 jax.config.update("jax_enable_x64", True)
 
@@ -370,6 +371,10 @@ def screen_pairs(
         denom = np.minimum(counts64[pi], counts64[pj]).astype(np.float64)
         keep = (denom > 0) & (inter.astype(np.float64)
                               >= c_floor * denom)
+        n = marker_mat.shape[0]
+        timing.counter("screen-candidates", int(pi.shape[0]))
+        timing.counter("screen-possible-pairs", n * (n - 1) // 2)
+        timing.counter("screen-kept-pairs", int(keep.sum()))
         return list(zip(pi[keep].tolist(), pj[keep].tolist()))
 
     if mesh is None and jax.device_count() > 1:
@@ -606,6 +611,7 @@ def _threshold_pairs_single(
     from galah_tpu.ops.compact import iter_blocks
 
     def run_block(r0, cap):
+        timing.dispatch()
         return _rowblock_candidates(
             jmat, jnp.int32(r0), j_thr_lo,
             sketch_size=sketch_size, k=k, row_tile=row_tile,
@@ -615,6 +621,7 @@ def _threshold_pairs_single(
     out: dict[tuple[int, int], float] = {}
     for r0, (flat_idx, common, total, count) in iter_blocks(
             n, row_tile, cap_per_row, run_block):
+        timing.dispatch(sync=True)
         count = int(count)
         flat_idx = np.asarray(flat_idx)[:count]
         common = np.asarray(common)[:count].astype(np.int64)

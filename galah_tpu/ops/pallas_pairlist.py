@@ -57,11 +57,20 @@ A_SUB = 8
 B_LANE = 128
 
 
-def _make_kernel(la: int, sb: int, sketch_size: int):
-    """Kernel for K_pad = 8*la = 128*sb; one program = one pair."""
+def _make_kernel(la: int, sb: int, sketch_size: int,
+                 range_skip: bool = False):
+    """Kernel for K_pad = 8*la = 128*sb; one program = one pair.
+
+    With `range_skip`, each lane column's 8 consecutive sorted a
+    values carry tight scalar [min, max] bounds (ONE query per
+    program, unlike the dense kernel's 8-query-pooled bounds), so b
+    chunks wholly below contribute a scalar 128 to every lt count and
+    chunks wholly above contribute nothing — only the 1-2 straddling
+    chunks run vector compares, guarded by pl.when so the skipped
+    work is actually skipped, not predicated."""
 
     def kernel(a_hi_ref, a_lo_ref, b_hi_ref, b_lo_ref,
-               common_ref, total_ref):
+               common_ref, total_ref, *scratch):
         umax = jnp.uint32(0xFFFFFFFF)
 
         ah = a_hi_ref[:, :]   # (8, la)
@@ -80,9 +89,51 @@ def _make_kernel(la: int, sb: int, sketch_size: int):
 
         lt_cols = []
         eq_cols = []
+        if range_skip:
+            lt_scr, eq_scr = scratch
+            b_first = [(bh_chunks[s][0, 0], bl_chunks[s][0, 0])
+                       for s in range(sb)]
+            b_last = [(bh_chunks[s][0, B_LANE - 1],
+                       bl_chunks[s][0, B_LANE - 1]) for s in range(sb)]
         for l in range(la):
             a_h = ah[:, l:l + 1]   # (8, 1)
             a_l = al[:, l:l + 1]
+            if range_skip:
+                # Column l holds sorted values a[8l..8l+7]; a wholly-
+                # below chunk can hold no sentinel (its max < a_min <=
+                # UMAX) so it adds exactly B_LANE to every row's lt and
+                # nothing to eq; a wholly-above chunk adds nothing to
+                # either. (An all-padding column has a_min = UMAX, so
+                # every valid chunk counts below — harmless: its rows
+                # are masked out of `match` by valid_a.)
+                amn_h, amn_l = ah[0, l], al[0, l]
+                amx_h, amx_l = ah[A_SUB - 1, l], al[A_SUB - 1, l]
+                lt_scr[:] = jnp.zeros((A_SUB, B_LANE), jnp.int32)
+                eq_scr[:] = jnp.zeros((A_SUB, B_LANE), jnp.int32)
+                n_below = jnp.int32(0)
+                for s in range(sb):
+                    fh, fl = b_first[s]
+                    lh, ll = b_last[s]
+                    below = (lh < amn_h) | ((lh == amn_h) & (ll < amn_l))
+                    above = (fh > amx_h) | ((fh == amx_h) & (fl > amx_l))
+                    n_below = n_below + below.astype(jnp.int32)
+
+                    @pl.when(~(below | above))
+                    def _(s=s, a_h=a_h, a_l=a_l):
+                        bh = bh_chunks[s]
+                        bl = bl_chunks[s]
+                        eq = (bh == a_h) & (bl == a_l)
+                        eq_scr[:] = eq_scr[:] + eq.astype(jnp.int32)
+                        lt = (bh < a_h) | ((bh == a_h) & (bl < a_l))
+                        lt_scr[:] = lt_scr[:] + lt.astype(jnp.int32)
+
+                lt_cols.append(
+                    jnp.sum(lt_scr[:], axis=1, keepdims=True,
+                            dtype=jnp.int32)
+                    + n_below * jnp.int32(B_LANE))
+                eq_cols.append(jnp.sum(eq_scr[:], axis=1, keepdims=True,
+                                       dtype=jnp.int32))
+                continue
             ltacc = jnp.zeros((A_SUB, B_LANE), jnp.int32)
             eqacc = jnp.zeros((A_SUB, B_LANE), jnp.int32)
             for s in range(sb):
@@ -121,16 +172,19 @@ def _make_kernel(la: int, sb: int, sketch_size: int):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("sketch_size", "interpret"))
+                   static_argnames=("sketch_size", "interpret",
+                                    "range_skip"))
 def pair_stats_pairs_pallas(
     rows_a: jax.Array,   # uint64 (B, K) sorted asc, SENTINEL-padded
     rows_b: jax.Array,   # uint64 (B, K)
     sketch_size: int,
     interpret: bool = False,
+    range_skip: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """(common, total) int32 (B,) for each (rows_a[p], rows_b[p]) pair
     — the Mosaic twin of the vmapped ops/pairwise._pair_stats used by
-    the screened sparse pipeline. Bit-identical integers."""
+    the screened sparse pipeline. Bit-identical integers (either
+    range_skip setting; see _make_kernel)."""
     b_in, k_in = rows_a.shape
     if b_in == 0:
         z = jnp.zeros((0,), jnp.int32)
@@ -158,7 +212,7 @@ def pair_stats_pairs_pallas(
     b_lo2 = b_lo.reshape(b_in * sb, B_LANE)
 
     common, total = pl.pallas_call(
-        _make_kernel(la, sb, sketch_size),
+        _make_kernel(la, sb, sketch_size, range_skip=bool(range_skip)),
         grid=(b_in,),
         in_specs=[
             pl.BlockSpec((A_SUB, la), lambda p: (p, _zi(p)),
@@ -180,6 +234,10 @@ def pair_stats_pairs_pallas(
             jax.ShapeDtypeStruct((b_in * A_SUB, B_LANE), jnp.int32),
             jax.ShapeDtypeStruct((b_in * A_SUB, B_LANE), jnp.int32),
         ],
+        scratch_shapes=(
+            [pltpu.VMEM((A_SUB, B_LANE), jnp.int32),
+             pltpu.VMEM((A_SUB, B_LANE), jnp.int32)]
+            if range_skip else []),
         interpret=interpret,
     )(a_hi2, a_lo2, b_hi2, b_lo2)
     return (common.reshape(b_in, A_SUB, B_LANE)[:, 0, 0],

@@ -41,11 +41,25 @@ from galah_tpu.ops.pairwise import (
     ani_to_jaccard,
     stats_to_ani_f64,
 )
+from galah_tpu.utils import timing
 
 # Candidate pairs evaluated per device dispatch. Large enough to
 # amortize dispatch latency (the gathered rows are B x K u64 reads
 # from HBM), small enough that the gather scratch stays tens of MB.
+# On TPU the default is 4x larger (HBM is plentiful and each dispatch
+# through a remote attach pays real RTT); GALAH_TPU_PAIR_BATCH
+# overrides either way.
 PAIR_BATCH = 8192
+
+
+def _default_pair_batch() -> int:
+    import os
+
+    env = os.environ.get("GALAH_TPU_PAIR_BATCH")
+    if env:
+        return max(1, int(env))
+    return 4 * PAIR_BATCH if jax.default_backend() == "tpu" \
+        else PAIR_BATCH
 
 
 @functools.partial(
@@ -108,7 +122,7 @@ def pair_stats_for_pairs(
     pj: np.ndarray,
     sketch_size: int,
     mesh: Optional[Mesh] = None,
-    batch: int = PAIR_BATCH,
+    batch: Optional[int] = None,
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -135,6 +149,8 @@ def pair_stats_for_pairs(
 
     jmat = jnp.asarray(np.ascontiguousarray(sketch_mat, dtype=np.uint64))
     n_dev = mesh.devices.size if mesh is not None else 1
+    if batch is None:
+        batch = _default_pair_batch()
     b = -(-batch // n_dev) * n_dev
 
     def make_fn(pallas: bool):
@@ -150,20 +166,89 @@ def pair_stats_for_pairs(
 
     pi32 = np.ascontiguousarray(pi, dtype=np.int32)
     pj32 = np.ascontiguousarray(pj, dtype=np.int32)
-    for s in range(0, n_pairs, b):
+    starts = list(range(0, n_pairs, b))
+
+    def dispatch(fn, s):
         e = min(s + b, n_pairs)
         bi = np.zeros(b, dtype=np.int32)
         bj = np.zeros(b, dtype=np.int32)
         bi[: e - s] = pi32[s:e]
         bj[: e - s] = pj32[s:e]
-        ji, jj = jnp.asarray(bi), jnp.asarray(bj)
-        # A Mosaic failure downgrades the remaining batches too
-        # (make_fn is cached/partial — rebuilding per batch is free).
-        (c, t), use_pallas = run_with_pallas_fallback(
-            "pairlist kernel", explicit, bool(use_pallas),
-            lambda p: make_fn(p)(jmat, ji, jj))
+        timing.dispatch()
+        return fn(jmat, jnp.asarray(bi), jnp.asarray(bj))
+
+    def store(s, c, t):
+        e = min(s + b, n_pairs)
+        timing.dispatch(sync=True)
         common[s:e] = np.asarray(c)[: e - s]
         total[s:e] = np.asarray(t)[: e - s]
+
+    # First batch materializes eagerly: Mosaic lowering/runtime
+    # failures surface here, where the fallback can still downgrade
+    # every remaining batch cheaply.
+    (c0, t0), use_pallas = run_with_pallas_fallback(
+        "pairlist kernel", explicit, bool(use_pallas),
+        lambda p: tuple(np.asarray(x)
+                        for x in dispatch(make_fn(p), starts[0])))
+    store(starts[0], c0, t0)
+
+    # Remaining batches PIPELINE with a bounded in-flight window:
+    # dispatches run ahead of the ordered host syncs so each sync's
+    # round trip (50-150 ms through a remote attach) overlaps the next
+    # batches' compute, while the window caps live device buffers —
+    # a mega-run can carry 100k+ batches, so unbounded queueing would
+    # hold O(n_batches * batch) device memory.
+    from collections import deque
+
+    fn = make_fn(bool(use_pallas))
+    window = 16
+    inflight: deque = deque()
+    todo = iter(starts[1:])
+
+    def downgrade_and_redo(failed_starts, was_pallas):
+        # A rare runtime (post-lowering) Mosaic failure — at enqueue or
+        # at host materialization: redo the failed batch and every
+        # remaining one on the XLA path, mirroring the first batch's
+        # run_with_pallas_fallback policy. `was_pallas` is the path the
+        # FAILING batch was dispatched on — an earlier drain may have
+        # downgraded the globals already, and that must not turn a
+        # recoverable Mosaic failure into a hard raise.
+        nonlocal use_pallas, fn
+        if explicit or not was_pallas:
+            raise  # noqa: PLE0704 - re-raise the active exception
+        if use_pallas:
+            use_pallas = False
+            fn = make_fn(False)
+        inflight.clear()
+        for s2 in failed_starts:
+            c2, t2 = dispatch(fn, s2)
+            store(s2, c2, t2)
+
+    def drain_one():
+        s, fut, was_pallas = inflight.popleft()
+        try:
+            c, t = fut
+            store(s, c, t)
+        except Exception:
+            downgrade_and_redo(
+                [s] + [s2 for s2, _, _ in inflight], was_pallas)
+
+    for s in todo:
+        try:
+            inflight.append((s, dispatch(fn, s), bool(use_pallas)))
+        except Exception:
+            # enqueue-time failure: settle what's already in flight,
+            # then redo this batch and the rest (on the XLA path when
+            # the failing dispatch was a Mosaic one)
+            was_pallas = bool(use_pallas)
+            while inflight:
+                drain_one()
+            downgrade_and_redo([s] + list(todo), was_pallas)
+            break
+        if len(inflight) >= window:
+            drain_one()
+    while inflight:
+        drain_one()
     return common, total
 
 
@@ -173,7 +258,7 @@ def threshold_pairs_sparse(
     min_ani: float,
     sketch_size: Optional[int] = None,
     mesh: Optional[Mesh] = None,
-    batch: int = PAIR_BATCH,
+    batch: Optional[int] = None,
 ) -> dict:
     """Sparse {(i, j): ani} for i<j pairs with ani >= min_ani — the
     screened device pipeline: host collision screen, batched gathered
@@ -192,6 +277,11 @@ def threshold_pairs_sparse(
     lens = (mat != np.uint64(SENTINEL)).sum(axis=1).astype(np.int64)
     j_thr = ani_to_jaccard(min_ani, k)
     pi, pj = candidate_pairs_minhash(mat, lens, j_thr, sketch_size)
+    # Survivor economics on the record (BASELINE.md dense-kernel
+    # decision): candidates = pairs the exact device pass must
+    # evaluate, out of n*(n-1)/2 possible.
+    timing.counter("screen-candidates", int(pi.shape[0]))
+    timing.counter("screen-possible-pairs", n * (n - 1) // 2)
     del n  # candidates are already in-bounds i < j < n
     if pi.shape[0] == 0:
         return {}
@@ -200,6 +290,7 @@ def threshold_pairs_sparse(
     common = common.astype(np.int64)
     total = total.astype(np.int64)
     keep = common.astype(np.float64) >= j_thr * total
+    timing.counter("screen-kept-pairs", int(keep.sum()))
     ani = stats_to_ani_f64(common[keep], total[keep], k)
     return {(int(a), int(b)): float(v)
             for a, b, v in zip(pi[keep], pj[keep], ani)}

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading as _threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -32,14 +33,34 @@ class StageTimer:
         self._counters: Dict[str, int] = {}
         self._counter_order: List[str] = []
         self._t0 = time.perf_counter()
+        self._active = _threading.local()
+        self._lock = _threading.Lock()
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._active, "stack", None)
+        if st is None:
+            st = self._active.stack = []
+        return st
+
+    def dispatch(self, n: int = 1, sync: bool = False) -> None:
+        """Record `n` device dispatches (jit executions / uploads)
+        attributed to the innermost active stage — with sync=True they
+        are host materializations (each a device->host round trip).
+        On a remote-attached device every round trip costs real RTT;
+        these counters let the stage report show round trips alongside
+        wall-clock, so dispatch-bound stages are visible as such."""
+        st = self._stack()
+        where = st[-1] if st else "?"
+        self.counter(f"{'sync' if sync else 'disp'}[{where}]", n)
 
     def counter(self, name: str, delta: int) -> None:
         """Accumulate a named integer (work counts, waste counts, ...);
         counters appear at the end of the stage report."""
-        if name not in self._counters:
-            self._counters[name] = 0
-            self._counter_order.append(name)
-        self._counters[name] += int(delta)
+        with self._lock:  # dispatch counts arrive from worker threads
+            if name not in self._counters:
+                self._counters[name] = 0
+                self._counter_order.append(name)
+            self._counters[name] += int(delta)
 
     def counters(self) -> Dict[str, int]:
         return dict(self._counters)
@@ -47,9 +68,11 @@ class StageTimer:
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
         start = time.perf_counter()
+        self._stack().append(name)
         try:
             yield
         finally:
+            self._stack().pop()
             dt = time.perf_counter() - start
             if name not in self._acc:
                 self._acc[name] = 0.0
@@ -90,6 +113,10 @@ def stage(name: str):
 
 def counter(name: str, delta: int) -> None:
     GLOBAL.counter(name, delta)
+
+
+def dispatch(n: int = 1, sync: bool = False) -> None:
+    GLOBAL.dispatch(n, sync=sync)
 
 
 def reset() -> None:

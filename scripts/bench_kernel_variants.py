@@ -74,6 +74,8 @@ def main():
 
     run(f"pairlist-mosaic B={b}",
         lambda: pair_stats_pairs_pallas(pa, pb, K), b)
+    run(f"pairlist-mosaic+skip B={b}",
+        lambda: pair_stats_pairs_pallas(pa, pb, K, range_skip=True), b)
     run(f"pairlist-xla B={b}", lambda: xla_pairs(pa, pb), b)
 
     for n in (128, 512):

@@ -38,6 +38,14 @@ def main() -> None:
                     help="also run the quality-ordered rung: synthetic "
                          "CheckM2 report + Parks2020_reduced ranking "
                          "(BASELINE.json rung-4 semantics)")
+    ap.add_argument("--mega", action="store_true",
+                    help="dense-similarity worst case: ONE planted "
+                         "mega-family (every pair >95%% ANI) through "
+                         "the DEFAULT skani+skani path — the 'many "
+                         "closely related genomes' regime the "
+                         "reference advertises "
+                         "(reference: README.md:18-26). Replaces "
+                         "rung 2; --n sets the family size.")
     args = ap.parse_args()
 
     if args.cpu:
@@ -68,6 +76,7 @@ def main() -> None:
             "wall_s": round(dt, 2), "n_clusters": len(clusters),
             "genomes_per_s": round(len(paths) / dt, 3),
             "stages": stages,
+            "counters": timing.GLOBAL.counters(),
         })
         print(json.dumps(results[-1]), flush=True)
 
@@ -94,11 +103,25 @@ def main() -> None:
     import importlib
 
     bench = importlib.import_module("bench")
-    n_fam = max(args.n // 4, 1)
-    paths = bench._synth_families(
-        n_genomes=args.n, genome_len=args.genome_len,
-        n_families=n_fam, mut=0.03, seed=11)
-    run(f"rung2-synthetic-{args.n}", paths, dict(base_values))
+    if args.mega:
+        # All N genomes are ~2%-mutated copies of ONE base, so every
+        # pair sits near 96% ANI and NOTHING screens out: the collision
+        # screen's mega-run dedup, the single giant precluster's
+        # transform_ids, and the greedy phase on one huge candidate
+        # list are all on the hot path. Default config (skani+skani).
+        paths = bench._synth_families(
+            n_genomes=args.n, genome_len=args.genome_len,
+            n_families=1, mut=0.02, seed=11)
+        values = dict(base_values)
+        values["precluster_method"] = "skani"
+        values["cluster_method"] = "skani"
+        run(f"rung-mega-{args.n}", paths, values)
+    else:
+        n_fam = max(args.n // 4, 1)
+        paths = bench._synth_families(
+            n_genomes=args.n, genome_len=args.genome_len,
+            n_families=n_fam, mut=0.03, seed=11)
+        run(f"rung2-synthetic-{args.n}", paths, dict(base_values))
 
     if args.rung4:
         # rung 4 semantics: quality-ordered greedy rep selection from a

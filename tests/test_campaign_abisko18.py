@@ -81,11 +81,13 @@ def mag_paths(ref_data):
     return paths
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("pre,cl", COMBOS_95)
 def test_all18_at_95(mag_paths, pre, cl):
     assert _run(mag_paths, pre, cl, 95.0) == GOLDEN_95
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("pre,cl", COMBOS_99)
 def test_all18_at_99(mag_paths, pre, cl):
     assert _run(mag_paths, pre, cl, 99.0) == GOLDEN_99

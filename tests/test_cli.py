@@ -170,6 +170,7 @@ def test_github7_aligned_fraction_semantics(tmp_path):
     assert out.read_text() == f"{DATA}/antonio_mags/BE_RX_R2_MAG52.fna\n"
 
 
+@pytest.mark.slow
 def test_skani_skani_precluster_threshold_override(tmp_path):
     """Reference: tests/test_cmdline.rs test_skani_skani_clusterer —
     with skani+skani, --precluster-ani 99 is overridden by --ani 95 and

@@ -81,6 +81,7 @@ def test_minhash_skani_two_clusters_same_ani(ref_data, profile_store):
     assert _sorted_clusters(out) == [[0, 1, 3], [2]]
 
 
+@pytest.mark.slow
 def test_skani_skani_two_clusters_same_ani(ref_data, profile_store):
     out = cluster(
         _paths(ref_data, ABISKO),
@@ -92,6 +93,7 @@ def test_skani_skani_two_clusters_same_ani(ref_data, profile_store):
     assert _sorted_clusters(out) == [[0, 1, 3], [2]]
 
 
+@pytest.mark.slow
 def test_skani_skani_two_preclusters(ref_data, profile_store):
     out = cluster(
         _paths(ref_data, ABISKO + ["antonio_mags/BE_RX_R2_MAG52.fna"]),

@@ -106,6 +106,7 @@ ABISKO = [
 ]
 
 
+@pytest.mark.slow
 def test_hll_fastani_golden_clusters(ref_data):
     """dashing-precluster + fastANI-cluster reproduces the reference's
     golden compositions (reference: src/clusterer.rs:481-533)."""

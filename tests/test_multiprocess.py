@@ -16,6 +16,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "_dist_worker.py")
@@ -105,6 +106,7 @@ def _write_family_genomes(root):
     return paths
 
 
+@pytest.mark.slow
 def test_two_process_end_to_end_cluster(tmp_path):
     """Full cluster() across 2 real processes with per-host FASTA
     ingestion (the MinHash backend splits reading + sketching by

@@ -44,7 +44,7 @@ def _rand_sketches(rng, n, width, n_valid_max):
     return mat
 
 
-@pytest.mark.parametrize("width,sketch_size", [(1000, 1000), (512, 500)])
+@pytest.mark.parametrize("width,sketch_size", [pytest.param(1000, 1000, marks=pytest.mark.slow), (512, 500)])
 def test_minhash_pair_stats_parity(width, sketch_size):
     """tile_stats_pallas must be bit-identical to the XLA searchsorted
     path on (common, total) — including short sketches, sentinel padding
@@ -97,6 +97,7 @@ def test_threshold_pairs_pallas_interpret_matches_xla():
         assert abs(via_pallas[key] - via_xla[key]) < 1e-5
 
 
+@pytest.mark.slow
 def test_minhash_pair_stats_range_skip_parity():
     """The range-skip variant (prefix bulk-count + suffix skip over
     sorted b-chunks) must stay bit-identical to the XLA path."""

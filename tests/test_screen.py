@@ -92,6 +92,7 @@ def test_screen_dispatch_count_scales_with_row_blocks(monkeypatch):
     assert len(calls) == 128 // 32
 
 
+@pytest.mark.slow
 def test_skani_preclusterer_uses_blocked_screen(ref_data):
     """The backend end-to-end: screening via the blocked path still finds
     the known closely-related abisko4 MAG pairs."""
