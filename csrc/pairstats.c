@@ -322,3 +322,77 @@ void galah_window_match_counts_merge(
         if (r < H && ref[r] == h) matched[qw[i]]++;
     }
 }
+
+/* Batched sorted-merge membership counter: the per-PAIR-LIST twin of
+ * galah_window_match_counts_merge, for the exact-ANI stage when the
+ * pair volume is large (the dense-similarity regime can carry N^2/2
+ * screened pairs — a 5k-genome mega-family is 12.5M of them, and the
+ * Python per-pair loop around the single-pair entry costs ~100x the
+ * merge itself at typical small-genome sizes).
+ *
+ * Per-genome query data (qh/qw concatenated, offset by q_off) and
+ * per-genome sorted distinct ref sets (ref concatenated, offset by
+ * r_off) are laid out once by the caller; pair p counts query
+ * pair_q[p] against ref pair_r[p] into the concatenated matched
+ * output at m_off[p] (caller-computed prefix of each query's window
+ * count; the output buffer must be zeroed). Pairs are independent —
+ * split across threads; when H is much smaller than nq the merge
+ * degenerates gracefully (it is O(nq + H) either way). */
+typedef struct {
+    const uint64_t *qh_cat;
+    const int32_t *qw_cat;
+    const int64_t *q_off;     /* per-genome [g, g+1) into qh/qw */
+    const uint64_t *ref_cat;
+    const int64_t *r_off;     /* per-genome [g, g+1) into ref_cat */
+    const int32_t *pair_q, *pair_r;
+    const int64_t *m_off;     /* per-pair output offset */
+    int64_t n_pairs;
+    int32_t *matched_cat;
+    int tid, n_threads;
+} wmb_job;
+
+static void *wmb_worker(void *arg) {
+    wmb_job *w = (wmb_job *)arg;
+    for (int64_t p = w->tid; p < w->n_pairs; p += w->n_threads) {
+        int64_t qg = w->pair_q[p], rg = w->pair_r[p];
+        const uint64_t *qh = w->qh_cat + w->q_off[qg];
+        const int32_t *qw = w->qw_cat + w->q_off[qg];
+        int64_t nq = w->q_off[qg + 1] - w->q_off[qg];
+        const uint64_t *ref = w->ref_cat + w->r_off[rg];
+        int64_t H = w->r_off[rg + 1] - w->r_off[rg];
+        int32_t *matched = w->matched_cat + w->m_off[p];
+        int64_t r = 0;
+        for (int64_t i = 0; i < nq; i++) {
+            uint64_t h = qh[i];
+            while (r < H && ref[r] < h) r++;
+            if (r < H && ref[r] == h) matched[qw[i]]++;
+        }
+    }
+    return NULL;
+}
+
+void galah_window_match_counts_merge_batch(
+    const uint64_t *qh_cat, const int32_t *qw_cat,
+    const int64_t *q_off, const uint64_t *ref_cat,
+    const int64_t *r_off, const int32_t *pair_q,
+    const int32_t *pair_r, const int64_t *m_off, int64_t n_pairs,
+    int n_threads, int32_t *matched_cat) {
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > 64) n_threads = 64;
+    if ((int64_t)n_threads > n_pairs)
+        n_threads = n_pairs > 0 ? (int)n_pairs : 1;
+    wmb_job jobs[64];
+    pthread_t tids[64];
+    for (int t = 0; t < n_threads; t++)
+        jobs[t] = (wmb_job){qh_cat, qw_cat, q_off, ref_cat, r_off,
+                            pair_q, pair_r, m_off, n_pairs,
+                            matched_cat, t, n_threads};
+    if (n_threads == 1) {
+        wmb_worker(&jobs[0]);
+        return;
+    }
+    for (int t = 0; t < n_threads; t++)
+        pthread_create(&tids[t], NULL, wmb_worker, &jobs[t]);
+    for (int t = 0; t < n_threads; t++)
+        pthread_join(tids[t], NULL);
+}

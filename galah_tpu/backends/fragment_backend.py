@@ -209,10 +209,9 @@ class _FragmentANIMixin:
                 by_path = dict(zip(unique, self.store.get_many(unique)))
             profs = [(by_path[a], by_path[b]) for a, b in pairs]
         with timing.stage("fragment-ani"):
-            results = fragment_ani.bidirectional_ani_batch(
+            return fragment_ani.bidirectional_ani_values(
                 profs, min_aligned_frac=self.min_aligned_fraction,
                 threads=self.store.threads)
-        return [ani for ani, _, _ in results]
 
 
 class FastANIEquivalentClusterer(ClusterBackend, _FragmentANIMixin):
@@ -350,11 +349,10 @@ class SkaniPreclusterer(PreclusterBackend):
                         [genome_paths[g] for g in missing])))
             prof.update(
                 (g, warm[g]) for g in endpoints if g in warm)
-            results = fragment_ani.bidirectional_ani_batch(
+            return fragment_ani.bidirectional_ani_values(
                 [(prof[i], prof[j]) for i, j in my_pairs],
                 min_aligned_frac=self.min_aligned_fraction,
                 threads=self.store.threads)
-            return [ani for ani, _, _ in results]
 
         return distributed.sharded_optional_floats(
             len(pairs), compute_mine, owner=lambda k: pairs[k][1])
@@ -398,11 +396,11 @@ class SkaniPreclusterer(PreclusterBackend):
                     if ani is not None and ani >= self.threshold:
                         cache.insert((i, j), float(ani))
         else:
-            results = fragment_ani.bidirectional_ani_batch(
+            anis = fragment_ani.bidirectional_ani_values(
                 [(profiles[i], profiles[j]) for i, j in pairs],
                 min_aligned_frac=self.min_aligned_fraction,
                 threads=self.store.threads)
-            for (i, j), (ani, _, _) in zip(pairs, results):
+            for (i, j), ani in zip(pairs, anis):
                 if ani is not None and ani >= self.threshold:
                     cache.insert((i, j), ani)
         logger.info("Found %d pairs passing precluster threshold %.4f",

@@ -118,7 +118,7 @@ def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,
 
         timing.counter("screen-candidates", int(pi.shape[0]))
         timing.counter("screen-possible-pairs", n * (n - 1) // 2)
-        out_ani = np.empty(pi.shape[0], dtype=np.float64)
+        out_ani = np.full(pi.shape[0], float("-inf"), dtype=np.float64)
         if pi.shape[0]:
             _fn_pl(
                 mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
@@ -130,6 +130,8 @@ def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,
                     ctypes.POINTER(ctypes.c_int64)),
                 sketch_size, kmer, float(j_thr), int(threads),
                 out_ani.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        timing.counter("screen-kept-pairs",
+                       int((out_ani != float("-inf")).sum()))
         return {(int(a), int(b)): float(v)
                 for a, b, v in zip(pi, pj, out_ani)
                 if v != float("-inf")}
@@ -239,5 +241,64 @@ def window_match_counts_merge(
         qh.shape[0],
         ref_set.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         ref_set.shape[0],
+        matched.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return matched
+
+_fn_wmb = _lib.galah_window_match_counts_merge_batch
+_fn_wmb.restype = None
+_fn_wmb.argtypes = [
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+    ctypes.c_int64, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+]
+
+
+def window_match_counts_merge_batch(
+        qh_cat: np.ndarray, qw_cat: np.ndarray, q_off: np.ndarray,
+        ref_cat: np.ndarray, r_off: np.ndarray, pair_q: np.ndarray,
+        pair_r: np.ndarray, m_off: np.ndarray, total_windows: int,
+        threads: int = 1) -> np.ndarray:
+    """Concatenated per-window matched counts for a PAIR LIST — the
+    batched twin of window_match_counts_merge (bit-identical counts per
+    pair), with the per-pair loop and threading in C. Layouts (all
+    contiguous, caller-guaranteed in-bounds — GenomeProfile data):
+
+      qh_cat/qw_cat: per-genome sorted_query() arrays concatenated,
+        genome g at [q_off[g], q_off[g+1]);
+      ref_cat: per-genome sorted distinct ref sets concatenated,
+        genome g at [r_off[g], r_off[g+1]);
+      pair_q/pair_r: genome indices per pair (int32);
+      m_off: per-pair output offset (int64 prefix over each pair's
+        query window count), with `total_windows` the grand total.
+
+    Returns the zero-initialized (total_windows,) int32 matched array
+    filled per pair at [m_off[p], m_off[p] + n_windows(pair_q[p]))."""
+    qh_cat = np.ascontiguousarray(qh_cat, dtype=np.uint64)
+    qw_cat = np.ascontiguousarray(qw_cat, dtype=np.int32)
+    q_off = np.ascontiguousarray(q_off, dtype=np.int64)
+    ref_cat = np.ascontiguousarray(ref_cat, dtype=np.uint64)
+    r_off = np.ascontiguousarray(r_off, dtype=np.int64)
+    pair_q = np.ascontiguousarray(pair_q, dtype=np.int32)
+    pair_r = np.ascontiguousarray(pair_r, dtype=np.int32)
+    m_off = np.ascontiguousarray(m_off, dtype=np.int64)
+    if qh_cat.shape != qw_cat.shape:
+        raise ValueError("qh_cat/qw_cat shape mismatch")
+    if pair_q.shape != pair_r.shape or pair_q.shape != m_off.shape:
+        raise ValueError("pair array shape mismatch")
+    matched = np.zeros(int(total_windows), dtype=np.int32)
+    if pair_q.shape[0] == 0:
+        return matched
+    _fn_wmb(
+        qh_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        qw_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        q_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ref_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        r_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        pair_q.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        pair_r.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        m_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        pair_q.shape[0], int(threads),
         matched.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     return matched

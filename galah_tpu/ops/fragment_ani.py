@@ -401,11 +401,17 @@ class DirectedANI:
     frags_total: int
 
 
+# Fraction of a window's k-mer slots that must be valid for it to
+# count as a fragment — shared by every ANI entry point so the
+# per-pair and batched-array paths cannot drift apart.
+DEFAULT_MIN_WINDOW_VALID_FRAC = 0.5
+
+
 def directed_ani(
     query: GenomeProfile,
     ref: GenomeProfile,
     identity_floor: float = 0.80,
-    min_window_valid_frac: float = 0.5,
+    min_window_valid_frac: float = DEFAULT_MIN_WINDOW_VALID_FRAC,
 ) -> DirectedANI:
     """One-way fragment ANI of `query` against `ref` (device dispatch).
 
@@ -419,6 +425,18 @@ def directed_ani(
     return _directed_from_counts(
         np.asarray(matched), np.asarray(total), query,
         identity_floor, min_window_valid_frac)
+
+
+def _seq_sum(a: np.ndarray) -> float:
+    """Strictly left-to-right f64 sum (np.add.reduceat order).
+
+    np.mean/np.sum use pairwise summation above tiny sizes; the
+    batched twin (_directed_from_counts_arrays) reduces segments with
+    np.add.reduceat, which is sequential — both paths use THIS order
+    so their ANI floats are bit-identical, window count regardless."""
+    if a.shape[0] == 0:
+        return 0.0
+    return float(np.add.reduceat(a, np.zeros(1, dtype=np.intp))[0])
 
 
 def _directed_from_counts(
@@ -452,19 +470,205 @@ def _directed_from_counts(
     # subtracting it from aligned windows' matched fraction removes the
     # upward bias before inverting the k-mer survival model.
     below = frag_ok & ~aligned
-    r_est = float(c_w[below].mean()) if below.any() else 0.0
+    r_est = (_seq_sum(c_w[below]) / int(below.sum())
+             if below.any() else 0.0)
     c_adj = np.clip((c_w[aligned] - r_est) / max(1.0 - r_est, 1e-9),
                     1e-12, 1.0)
     identity = c_adj ** (1.0 / k)
-    ani = float(identity.mean())
+    ani = _seq_sum(identity) / frags_matching
     af = frags_matching / max(frags_total, 1)
     return DirectedANI(ani, af, frags_matching, frags_total)
+
+
+def _directed_from_counts_arrays(
+    matched_cat: np.ndarray,   # (W_total,) int32, segments per pair
+    total_cat: np.ndarray,     # (W_total,) int32, aligned to matched
+    starts: np.ndarray,        # (n_pairs,) int64 segment starts
+    k: int,
+    fraglen: int,
+    subsample_c: int,
+    identity_floor: float,
+    min_window_valid_frac: float,
+):
+    """Vectorized batch twin of _directed_from_counts over concatenated
+    per-pair window segments — bit-identical floats (all segment
+    reductions are np.add.reduceat, the same left-to-right order
+    _seq_sum pins for the per-pair path; masked-out windows contribute
+    exact +0.0 terms, which cannot change an f64 sum).
+
+    Returns (ani, af, frags_matching, frags_total) arrays, one entry
+    per pair."""
+    matched = matched_cat.astype(np.float64)
+    total = total_cat.astype(np.float64)
+    starts = np.ascontiguousarray(starts, dtype=np.intp)
+
+    min_valid = (min_window_valid_frac * (fraglen - k + 1)
+                 / subsample_c)
+    frag_ok = total >= max(min_valid, 1.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        c_w = np.where(frag_ok, matched / np.maximum(total, 1.0), 0.0)
+    c_floor = identity_floor ** k
+    aligned = frag_ok & (c_w >= c_floor)
+
+    frags_total = np.add.reduceat(
+        frag_ok.astype(np.int64), starts)
+    frags_matching = np.add.reduceat(
+        aligned.astype(np.int64), starts)
+
+    below = frag_ok & ~aligned
+    cnt_below = np.add.reduceat(below.astype(np.int64), starts)
+    sum_below = np.add.reduceat(np.where(below, c_w, 0.0), starts)
+    r_est = np.where(cnt_below > 0,
+                     sum_below / np.maximum(cnt_below, 1), 0.0)
+
+    seg_lens = np.diff(np.append(starts, matched.shape[0]))
+    r_w = np.repeat(r_est, seg_lens)
+    c_adj = np.clip((c_w - r_w) / np.maximum(1.0 - r_w, 1e-9),
+                    1e-12, 1.0)
+    identity = np.where(aligned, c_adj ** (1.0 / k), 0.0)
+    sum_id = np.add.reduceat(identity, starts)
+
+    has = frags_matching > 0
+    ani = np.where(has, sum_id / np.maximum(frags_matching, 1), 0.0)
+    af = np.where(
+        has,
+        frags_matching / np.maximum(frags_total, 1).astype(np.float64),
+        0.0)
+    return ani, af, frags_matching, frags_total
+
+
+# Window elements per batched-merge chunk: bounds the concatenated
+# matched/total scratch (~26 B/window across the f64 temporaries) to
+# ~200 MB while keeping chunks big enough to amortize the C call.
+_MERGE_BATCH_WINDOW_CAP = 8 << 20
+
+
+def _directed_ani_batch_c(
+    queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
+    identity_floor: float,
+    min_window_valid_frac: float,
+    threads: int,
+) -> "list[DirectedANI]":
+    """Boxed twin of _directed_ani_arrays_c (same arrays, DirectedANI
+    objects out) — the directed_ani_batch fast path."""
+    ani, af, fm, ft = _directed_ani_arrays_c(
+        queries, identity_floor, min_window_valid_frac, threads)
+    return [DirectedANI(float(ani[i]), float(af[i]),
+                        int(fm[i]), int(ft[i]))
+            for i in range(len(queries))]
+
+
+def _directed_ani_arrays_c(
+    queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
+    identity_floor: float,
+    min_window_valid_frac: float,
+    threads: int,
+):
+    """Batched CPU exact-ANI: per-pair merges in ONE threaded C call
+    per chunk (csrc/pairstats.c::galah_window_match_counts_merge_batch)
+    plus vectorized post-math, returning per-pair (ani, af,
+    frags_matching, frags_total) ARRAYS — the per-pair Python loop
+    costs ~100x the O(nq + H) merge itself at small-genome sizes,
+    which is the entire wall of the dense-similarity mega-family
+    regime (BASELINE.md rung-mega row; reference analog: one skani
+    call per screened pair, src/skani.rs:85-104)."""
+    from galah_tpu.ops._cpairstats import window_match_counts_merge_batch
+
+    q0 = queries[0][0]
+    k, fraglen, subsample_c = q0.k, q0.fraglen, q0.subsample_c
+
+    # Unique profiles by object identity; sorted_query/ref_set are
+    # cached per profile, so concatenation cost is one copy.
+    q_idx: "dict[int, int]" = {}
+    r_idx: "dict[int, int]" = {}
+    q_profiles: "list[GenomeProfile]" = []
+    r_profiles: "list[GenomeProfile]" = []
+    pair_q = np.empty(len(queries), dtype=np.int32)
+    pair_r = np.empty(len(queries), dtype=np.int32)
+    for n, (q, r) in enumerate(queries):
+        qi = q_idx.setdefault(id(q), len(q_profiles))
+        if qi == len(q_profiles):
+            q_profiles.append(q)
+        ri = r_idx.setdefault(id(r), len(r_profiles))
+        if ri == len(r_profiles):
+            r_profiles.append(r)
+        pair_q[n] = qi
+        pair_r[n] = ri
+
+    qh_parts, qw_parts, tot_parts = [], [], []
+    for q in q_profiles:
+        qh, qw, totals = q.sorted_query()
+        qh_parts.append(qh)
+        qw_parts.append(qw)
+        tot_parts.append(totals)
+    q_off = np.zeros(len(q_profiles) + 1, dtype=np.int64)
+    np.cumsum([p.shape[0] for p in qh_parts], out=q_off[1:])
+    tot_off = np.zeros(len(q_profiles) + 1, dtype=np.int64)
+    np.cumsum([t.shape[0] for t in tot_parts], out=tot_off[1:])
+    qh_cat = (np.concatenate(qh_parts) if qh_parts
+              else np.zeros(0, dtype=np.uint64))
+    qw_cat = (np.concatenate(qw_parts) if qw_parts
+              else np.zeros(0, dtype=np.int32))
+    tot_cat = (np.concatenate(tot_parts) if tot_parts
+               else np.zeros(0, dtype=np.int32))
+    n_win = np.asarray([t.shape[0] for t in tot_parts], dtype=np.int64)
+
+    r_off = np.zeros(len(r_profiles) + 1, dtype=np.int64)
+    np.cumsum([p.ref_set.shape[0] for p in r_profiles], out=r_off[1:])
+    ref_cat = (np.concatenate([p.ref_set for p in r_profiles])
+               if r_profiles else np.zeros(0, dtype=np.uint64))
+
+    out_ani = np.zeros(len(queries), dtype=np.float64)
+    out_af = np.zeros(len(queries), dtype=np.float64)
+    out_fm = np.zeros(len(queries), dtype=np.int64)
+    out_ft = np.zeros(len(queries), dtype=np.int64)
+    pair_wins = n_win[pair_q]
+    # zero-window queries never enter the C kernel (reduceat cannot
+    # represent empty segments); their result is the all-zero row the
+    # outputs are initialized to
+    live = np.nonzero(pair_wins != 0)[0]
+
+    pos = 0
+    while pos < live.shape[0]:
+        # chunk by total window volume
+        end = pos
+        vol = 0
+        while end < live.shape[0] and (vol == 0
+                                       or vol + pair_wins[live[end]]
+                                       <= _MERGE_BATCH_WINDOW_CAP):
+            vol += int(pair_wins[live[end]])
+            end += 1
+        chunk = live[pos:end]
+        pos = end
+
+        cw = pair_wins[chunk]
+        m_off = np.zeros(chunk.shape[0], dtype=np.int64)
+        np.cumsum(cw[:-1], out=m_off[1:])
+        total_windows = int(cw.sum())
+        matched_cat = window_match_counts_merge_batch(
+            qh_cat, qw_cat, q_off, ref_cat, r_off,
+            pair_q[chunk], pair_r[chunk], m_off, total_windows,
+            threads=max(1, threads))
+        # gather each pair's per-window valid counts
+        within = np.arange(total_windows, dtype=np.int64) \
+            - np.repeat(m_off, cw)
+        tidx = np.repeat(tot_off[pair_q[chunk]], cw) + within
+        total_cat = tot_cat[tidx]
+
+        ani, af, fm, ft = _directed_from_counts_arrays(
+            matched_cat, total_cat, m_off, k, fraglen, subsample_c,
+            identity_floor, min_window_valid_frac)
+        out_ani[chunk] = ani
+        out_af[chunk] = af
+        out_fm[chunk] = fm
+        out_ft[chunk] = ft
+    return out_ani, out_af, out_fm, out_ft
 
 
 def directed_ani_batch(
     queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
     identity_floor: float = 0.80,
-    min_window_valid_frac: float = 0.5,
+    min_window_valid_frac: float = DEFAULT_MIN_WINDOW_VALID_FRAC,
     threads: int = 1,
 ) -> "list[DirectedANI]":
     """Directed fragment ANI for many (query, ref) pairs, coalescing
@@ -493,6 +697,19 @@ def directed_ani_batch(
         except ImportError:
             window_match_counts_merge = None  # no C toolchain: JAX
         if window_match_counts_merge is not None:
+            # Large pair lists (the dense-similarity regime can carry
+            # N^2/2 screened pairs) take the fully batched path: ONE
+            # threaded C call per chunk for the merges and vectorized
+            # host post-math — bit-identical DirectedANI floats to the
+            # per-pair loop below (see _directed_from_counts_arrays).
+            if len(queries) >= 64:
+                uniform = len({(q.k, q.fraglen, q.subsample_c)
+                               for q, _ in queries}) == 1
+                if uniform:
+                    return _directed_ani_batch_c(
+                        queries, identity_floor, min_window_valid_frac,
+                        threads)
+
             def one(pair):
                 q, r = pair
                 qh, qw, totals = q.sorted_query()
@@ -651,6 +868,53 @@ def bidirectional_ani_batch(
         out.append((_combine_bidirectional(ab, ba, min_aligned_frac),
                     ab, ba))
     return out
+
+
+def bidirectional_ani_values(
+    pairs: "list[Tuple[GenomeProfile, GenomeProfile]]",
+    min_aligned_frac: float,
+    identity_floor: float = 0.80,
+    threads: int = 1,
+) -> "list[Optional[float]]":
+    """ANI values only — `[ani for ani, _, _ in
+    bidirectional_ani_batch(...)]` with the DirectedANI boxing removed
+    on the batched-C path (at mega-pair volumes the 2x-per-pair object
+    construction and per-pair gate loop dominate the exact math;
+    identical Nones/floats either way — the gate arithmetic is the
+    same f64 ops _combine_bidirectional runs on ints)."""
+    use_arrays = (
+        len(pairs) >= 64
+        and jax.default_backend() == "cpu" and jax.device_count() == 1
+        and len({(p.k, p.fraglen, p.subsample_c)
+                 for pair in pairs for p in pair}) == 1)
+    if use_arrays:
+        try:
+            from galah_tpu.ops._cpairstats import (  # noqa: F401
+                window_match_counts_merge_batch,
+            )
+        except ImportError:
+            use_arrays = False  # no C toolchain
+    if not use_arrays:
+        return [ani for ani, _, _ in bidirectional_ani_batch(
+            pairs, min_aligned_frac, identity_floor=identity_floor,
+            threads=threads)]
+
+    n = len(pairs)
+    directed = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
+    ani, _af, fm, ft = _directed_ani_arrays_c(
+        directed, identity_floor, DEFAULT_MIN_WINDOW_VALID_FRAC,
+        threads)
+    ab, ba = slice(0, n), slice(n, 2 * n)
+    gate = (
+        ((ft[ab] > 0)
+         & (fm[ab] / np.maximum(ft[ab], 1) >= min_aligned_frac))
+        | ((ft[ba] > 0)
+           & (fm[ba] / np.maximum(ft[ba], 1) >= min_aligned_frac)))
+    has = (fm[ab] > 0) | (fm[ba] > 0)
+    keep = gate & has
+    val = np.maximum(ani[ab], ani[ba])
+    return [float(v) if k_ else None
+            for v, k_ in zip(val.tolist(), keep.tolist())]
 
 
 def _combine_bidirectional(

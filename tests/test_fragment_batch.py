@@ -197,3 +197,38 @@ def test_generic_batch_path_matches_c_path(tmp_path):
             np.testing.assert_array_equal(a.flat_hashes, b.flat_hashes)
             np.testing.assert_array_equal(a.ref_set, b.ref_set)
             np.testing.assert_array_equal(a.markers, b.markers)
+
+
+def test_directed_c_batch_path_parity(profiles, tmp_path):
+    """>=64 uniform pairs trigger the batched C merge + vectorized
+    post-math (_directed_ani_batch_c); every DirectedANI must be
+    bit-identical to the per-pair device-walker path, including
+    repeated profiles and an empty (zero-window) query."""
+    empty_fa = tmp_path / "tiny.fna"
+    empty_fa.write_bytes(b">c1\nACGTACGT\n")
+    tiny = fragment_ani.build_profile(
+        read_genome(str(empty_fa)), k=15, fraglen=3000)
+    assert tiny.n_windows == 0
+
+    queries = [(profiles[i % 4], profiles[(i + 1 + i // 4) % 4])
+               for i in range(90) if i % 4 != (i + 1 + i // 4) % 4]
+    queries += [(tiny, profiles[0]), (profiles[1], tiny)]
+    assert len(queries) >= 64
+    batched = fragment_ani.directed_ani_batch(queries)
+    for (q, r), got in zip(queries, batched):
+        assert got == fragment_ani.directed_ani(q, r)
+
+
+def test_bidirectional_values_parity(profiles):
+    """bidirectional_ani_values == the ani column of
+    bidirectional_ani_batch on both the per-pair (<64) and the
+    array (>=64) paths."""
+    small = [(profiles[i], profiles[j])
+             for i in range(4) for j in range(i + 1, 4)]
+    big = (small * 12)[:70]
+    for pairs in (small, big):
+        want = [ani for ani, _, _ in fragment_ani.bidirectional_ani_batch(
+            pairs, min_aligned_frac=0.2)]
+        got = fragment_ani.bidirectional_ani_values(
+            pairs, min_aligned_frac=0.2)
+        assert got == want
