@@ -170,3 +170,20 @@ def test_sparse_device_equals_c_kernel_at_scale():
     for key, v in via_device.items():
         assert via_c[key] == pytest.approx(v, abs=1e-12), key
     assert len(via_c) > 1000
+
+
+def test_dispatch_counters_recorded(monkeypatch):
+    """The sparse device pipeline records disp/sync counters under the
+    active stage — the per-stage round-trip visibility the TPU e2e
+    analysis relies on (utils/timing.dispatch)."""
+    from galah_tpu.utils import timing
+
+    mat = _family_sketches(n=64, width=48, n_fam=8, mutations=6)
+    monkeypatch.setenv("GALAH_TPU_SPARSE_MIN_N", "2")
+    timing.reset()
+    with timing.stage("unit-pairwise"):
+        threshold_pairs_sparse(mat, k=21, min_ani=0.90)
+    counters = timing.GLOBAL.counters()
+    assert counters.get("disp[unit-pairwise]", 0) >= 1
+    assert counters.get("sync[unit-pairwise]", 0) >= 1
+    assert counters["screen-candidates"] >= counters["screen-kept-pairs"]
