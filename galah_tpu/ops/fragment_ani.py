@@ -542,6 +542,39 @@ def _directed_from_counts_arrays(
 # ~200 MB while keeping chunks big enough to amortize the C call.
 _MERGE_BATCH_WINDOW_CAP = 8 << 20
 
+# Hard cap on the batched path's per-genome concatenation (qh/qw/ref
+# elements across unique profiles, ~28 B/element): ~1.8 GB. Above it
+# the per-pair loop runs instead — by then pairs-per-genome is low
+# (the cap is only reachable with many LARGE genomes, where the
+# screen keeps the pair list sparse and per-pair overhead is noise).
+_MERGE_BATCH_CONCAT_CAP = 64 << 20
+
+
+def _batch_path_worthwhile(
+    queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
+) -> bool:
+    """Whether the batched C path pays for its concatenation: enough
+    pairs to amortize the setup (>= 64) and a bounded concat volume.
+    The estimate mirrors _directed_ani_arrays_c's actual layout — a
+    genome contributes its query-role arrays AND its ref-role set
+    when it appears in both roles (the bidirectional case always has
+    both). Expected survivor counts (flat length / subsample) stand
+    in for len(sorted_query()) so no profile arrays are materialized
+    early."""
+    if len(queries) < 64:
+        return False
+    seen_q: "set[int]" = set()
+    seen_r: "set[int]" = set()
+    est = 0
+    for q, r in queries:
+        if id(q) not in seen_q:
+            seen_q.add(id(q))
+            est += q.flat_hashes.shape[0] // max(q.subsample_c, 1)
+        if id(r) not in seen_r:
+            seen_r.add(id(r))
+            est += r.ref_set.shape[0]
+    return est <= _MERGE_BATCH_CONCAT_CAP
+
 
 def _directed_ani_batch_c(
     queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
@@ -702,7 +735,7 @@ def directed_ani_batch(
             # threaded C call per chunk for the merges and vectorized
             # host post-math — bit-identical DirectedANI floats to the
             # per-pair loop below (see _directed_from_counts_arrays).
-            if len(queries) >= 64:
+            if _batch_path_worthwhile(queries):
                 uniform = len({(q.k, q.fraglen, q.subsample_c)
                                for q, _ in queries}) == 1
                 if uniform:
@@ -882,9 +915,15 @@ def bidirectional_ani_values(
     construction and per-pair gate loop dominate the exact math;
     identical Nones/floats either way — the gate arithmetic is the
     same f64 ops _combine_bidirectional runs on ints)."""
+    n = len(pairs)
+    directed = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
+    # Gate on the DIRECTED list — the same list (and therefore the
+    # same worthwhile/uniform decision) the bidirectional_ani_batch
+    # fallback's inner directed_ani_batch would gate on, so the two
+    # entries never disagree about the C batch path.
     use_arrays = (
-        len(pairs) >= 64
-        and jax.default_backend() == "cpu" and jax.device_count() == 1
+        jax.default_backend() == "cpu" and jax.device_count() == 1
+        and _batch_path_worthwhile(directed)
         and len({(p.k, p.fraglen, p.subsample_c)
                  for pair in pairs for p in pair}) == 1)
     if use_arrays:
@@ -898,9 +937,6 @@ def bidirectional_ani_values(
         return [ani for ani, _, _ in bidirectional_ani_batch(
             pairs, min_aligned_frac, identity_floor=identity_floor,
             threads=threads)]
-
-    n = len(pairs)
-    directed = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
     ani, _af, fm, ft = _directed_ani_arrays_c(
         directed, identity_floor, DEFAULT_MIN_WINDOW_VALID_FRAC,
         threads)
