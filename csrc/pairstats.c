@@ -396,3 +396,36 @@ void galah_window_match_counts_merge_batch(
     for (int t = 0; t < n_threads; t++)
         pthread_join(tids[t], NULL);
 }
+
+/* Window assembly from the profile walk's kept (pos, hash) pairs —
+ * O(n_valid) twins of galah_window_survivor_counts /
+ * galah_fill_compact_windows, which each stream the full
+ * 8-byte-per-bp flat array. Semantics identical: positions whose
+ * in-window column is >= L - (k - 1) (a k-mer crossing the window
+ * boundary) are dropped; survivors keep genome order within their
+ * window. counts must be zeroed; wins must be SENTINEL-filled. */
+void galah_window_counts_pairs(const int64_t *pos, int64_t nv,
+                               int64_t W, int64_t L, int k,
+                               int64_t *counts) {
+    int64_t tail = L - (k - 1);
+    for (int64_t i = 0; i < nv; i++) {
+        int64_t col = pos[i] % L;
+        if (col < tail) counts[pos[i] / L]++;
+    }
+    (void)W;
+}
+
+void galah_fill_windows_pairs(const int64_t *pos, const uint64_t *h,
+                              int64_t nv, int64_t W, int64_t L, int k,
+                              int64_t slots, int64_t *cursors,
+                              uint64_t *wins) {
+    int64_t tail = L - (k - 1);
+    for (int64_t i = 0; i < nv; i++) {
+        int64_t col = pos[i] % L;
+        if (col >= tail) continue;
+        int64_t w = pos[i] / L;
+        int64_t c = cursors[w]++;
+        if (c < slots) wins[w * slots + c] = h[i];
+    }
+    (void)W;
+}

@@ -268,32 +268,21 @@ int64_t galah_positional_hashes(const uint8_t *codes, int64_t n,
  * src/skani.rs:159-161). valid_out (capacity n - k + 1) receives the
  * kept hashes in genome order, duplicates included; *n_valid_out gets
  * the count. Returns n - k + 1, or 0 when n < k. */
+int64_t galah_positional_hashes_profile(
+    const uint8_t *codes, int64_t n, const int64_t *offsets,
+    int64_t n_offsets, int k, uint64_t seed, int algo, uint64_t cut,
+    uint64_t *out, uint64_t *valid_out, int64_t *pos_out,
+    int64_t *n_valid_out);
+
 int64_t galah_positional_hashes_masked(
     const uint8_t *codes, int64_t n, const int64_t *offsets,
     int64_t n_offsets, int k, uint64_t seed, int algo, uint64_t cut,
     uint64_t *out, uint64_t *valid_out, int64_t *n_valid_out) {
-    *n_valid_out = 0;
-    if (n < k || k < 1 || k > 32) return 0;
-    const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
-    int64_t nv = 0;
-    GALAH_WALK(codes, n, offsets, n_offsets, k, seed, algo,
-               {
-                   if (!cut) {
-                       /* keep-all: flat holds the raw hash; the valid
-                        * list still excludes a natural sentinel-valued
-                        * hash, matching the numpy != SENTINEL filter */
-                       out[WPOS] = WHASH;
-                       if (WHASH != SENT) valid_out[nv++] = WHASH;
-                   } else if (WHASH < cut) {
-                       out[WPOS] = WHASH;
-                       valid_out[nv++] = WHASH;
-                   } else {
-                       out[WPOS] = SENT;
-                   }
-               },
-               out[WPOS] = SENT);
-    *n_valid_out = nv;
-    return n - k + 1;
+    /* one walk body to keep in sync: the profile variant with a NULL
+     * position sink is this function */
+    return galah_positional_hashes_profile(
+        codes, n, offsets, n_offsets, k, seed, algo, cut, out,
+        valid_out, NULL, n_valid_out);
 }
 
 /* ---------------- HLL registers ------------------------------------ */
@@ -364,4 +353,45 @@ int64_t galah_sketch_bottomk(const uint8_t *codes, int64_t n,
     free(acc.sketch);
     free(acc.cand);
     return out_n;
+}
+
+/* galah_positional_hashes_masked plus the kept hashes' POSITIONS: the
+ * (pos, hash) pair list lets the window assembly run O(n_valid)
+ * instead of re-walking the 8-byte-per-bp flat array twice
+ * (csrc/pairstats.c::galah_window_counts_pairs / _fill_windows_pairs
+ * consume it). pos_out may be NULL (positions discarded) — the masked
+ * entry above is exactly that call, so there is ONE walk body. */
+int64_t galah_positional_hashes_profile(
+    const uint8_t *codes, int64_t n, const int64_t *offsets,
+    int64_t n_offsets, int k, uint64_t seed, int algo, uint64_t cut,
+    uint64_t *out, uint64_t *valid_out, int64_t *pos_out,
+    int64_t *n_valid_out) {
+    *n_valid_out = 0;
+    if (n < k || k < 1 || k > 32) return 0;
+    const uint64_t SENT = 0xFFFFFFFFFFFFFFFFull;
+    int64_t nv = 0;
+    GALAH_WALK(codes, n, offsets, n_offsets, k, seed, algo,
+               {
+                   if (!cut) {
+                       /* keep-all: flat holds the raw hash; the valid
+                        * list still excludes a natural sentinel-valued
+                        * hash, matching the numpy != SENTINEL filter */
+                       out[WPOS] = WHASH;
+                       if (WHASH != SENT) {
+                           valid_out[nv] = WHASH;
+                           if (pos_out) pos_out[nv] = WPOS;
+                           nv++;
+                       }
+                   } else if (WHASH < cut) {
+                       out[WPOS] = WHASH;
+                       valid_out[nv] = WHASH;
+                       if (pos_out) pos_out[nv] = WPOS;
+                       nv++;
+                   } else {
+                       out[WPOS] = SENT;
+                   }
+               },
+               out[WPOS] = SENT);
+    *n_valid_out = nv;
+    return n - k + 1;
 }

@@ -207,6 +207,56 @@ def compact_windows(flat: np.ndarray, n_windows: int, fraglen: int,
     return wins
 
 
+_fn_wcp = _lib.galah_window_counts_pairs
+_fn_wcp.restype = None
+_fn_wcp.argtypes = [
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+    ctypes.c_int64, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+]
+
+_fn_fwp = _lib.galah_fill_windows_pairs
+_fn_fwp.restype = None
+_fn_fwp.argtypes = [
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+    ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_uint64),
+]
+
+
+def windows_from_pairs(pos: np.ndarray, hashes: np.ndarray,
+                       n_windows: int, fraglen: int,
+                       k: int) -> np.ndarray:
+    """Compacted (W, slots) windows from the profile walk's kept
+    (pos, hash) pairs — bit-identical layout to compact_windows, in
+    O(n_valid) instead of two streaming passes over the 8-byte-per-bp
+    flat array."""
+    pos = np.ascontiguousarray(pos, dtype=np.int64)
+    hashes = np.ascontiguousarray(hashes, dtype=np.uint64)
+    if pos.shape != hashes.shape:
+        raise ValueError("pos/hashes shape mismatch")
+    if pos.shape[0] and (pos.min() < 0
+                         or pos.max() >= n_windows * fraglen):
+        raise ValueError("position out of range")
+    counts = np.zeros(max(n_windows, 1), dtype=np.int64)
+    _fn_wcp(
+        pos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        pos.shape[0], n_windows, fraglen, int(k),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    slots = max(int(counts[:n_windows].max()) if n_windows else 1, 1)
+    slots = min(-(-slots // 64) * 64, fraglen)
+    wins = np.full((max(n_windows, 1), slots), np.uint64(SENTINEL),
+                   dtype=np.uint64)
+    cursors = np.zeros(max(n_windows, 1), dtype=np.int64)
+    _fn_fwp(
+        pos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        hashes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        pos.shape[0], n_windows, fraglen, int(k), slots,
+        cursors.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        wins.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    return wins[:n_windows]
+
+
 _fn_wmm = _lib.galah_window_match_counts_merge
 _fn_wmm.restype = None
 _fn_wmm.argtypes = [

@@ -59,6 +59,16 @@ _fn_pos_masked.argtypes = [
     ctypes.POINTER(ctypes.c_int64),
 ]
 
+_fn_pos_profile = _lib.galah_positional_hashes_profile
+_fn_pos_profile.restype = ctypes.c_int64
+_fn_pos_profile.argtypes = [
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+]
+
 
 _fn_hll = _lib.galah_hll_registers
 _fn_hll.restype = ctypes.c_int64
@@ -163,3 +173,36 @@ def positional_hashes_masked(
         valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         ctypes.byref(n_valid))
     return out[:max(got, 0)], valid[:n_valid.value].copy()
+
+
+def positional_hashes_profile(
+        codes: np.ndarray, contig_offsets, k: int, cut: int,
+        seed: int = 0, algo: str = "murmur3",
+) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
+    """(flat, valid, pos): positional_hashes_masked plus the kept
+    hashes' positions — the (pos, hash) pairs drive the O(n_valid)
+    window assembly (ops/_cpairstats.windows_from_pairs), replacing
+    two full streaming passes over the flat array."""
+    _check(algo, k)
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    offs = np.ascontiguousarray(contig_offsets, dtype=np.int64)
+    n = codes.shape[0]
+    if n < k:
+        return (np.zeros(0, dtype=np.uint64),
+                np.zeros(0, dtype=np.uint64),
+                np.zeros(0, dtype=np.int64))
+    out = np.empty(n - k + 1, dtype=np.uint64)
+    valid = np.empty(n - k + 1, dtype=np.uint64)
+    pos = np.empty(n - k + 1, dtype=np.int64)
+    n_valid = ctypes.c_int64(0)
+    got = _fn_pos_profile(
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offs.shape[0], int(k), int(seed) & 0xFFFFFFFFFFFFFFFF,
+        _ALGOS[algo], int(cut) & 0xFFFFFFFFFFFFFFFF,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        pos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(n_valid))
+    nv = n_valid.value
+    return (out[:max(got, 0)], valid[:nv].copy(), pos[:nv].copy())

@@ -78,6 +78,11 @@ class GenomeProfile:
     # (sorted hashes, their window ids, per-window totals) — cached for
     # the C merge membership fast path; totals are pair-independent
     _np_sorted_query: "Optional[tuple]" = None
+    # kept (hash, position) pairs from the C profile walk: lets
+    # windows() assemble compacted rows in O(n_valid) instead of two
+    # streaming passes over the 8-byte-per-bp flat array
+    _kept_hashes: Optional[np.ndarray] = None
+    _kept_pos: Optional[np.ndarray] = None
 
     @property
     def n_windows(self) -> int:
@@ -141,15 +146,25 @@ class GenomeProfile:
         flat = self.flat_hashes
         w = self.n_windows
         if self.subsample_c > 1:
-            # Compacted layout in two streaming C passes — the numpy
-            # stable argsort below costs ~150 ms per 3 Mbp genome and
-            # was the realistic-rung exact-ANI wall. Bit-identical
-            # (tests/test_cpairstats.py), host-side on any backend.
+            # Compacted layout from the profile walk's kept (pos, hash)
+            # pairs when available — O(n_valid) assembly; else two
+            # streaming C passes over flat — both bit-identical to the
+            # numpy stable-argsort twin below (tests/test_cpairstats.py),
+            # which costs ~150 ms per 3 Mbp genome and was the
+            # realistic-rung exact-ANI wall. Host-side on any backend.
             try:
                 from galah_tpu.ops import _cpairstats
 
-                self._np_windows = _cpairstats.compact_windows(
-                    flat, w, L, self.k)
+                if self._kept_pos is not None:
+                    self._np_windows = _cpairstats.windows_from_pairs(
+                        self._kept_pos, self._kept_hashes, w, L,
+                        self.k)
+                    # consumed exactly once; the result is cached
+                    self._kept_pos = None
+                    self._kept_hashes = None
+                else:
+                    self._np_windows = _cpairstats.compact_windows(
+                        flat, w, L, self.k)
                 return self._np_windows
             except ImportError:
                 pass
@@ -245,16 +260,20 @@ def _check_subsample(subsample_c: int) -> None:
 
 
 def _finish_profile(path: str, flat: np.ndarray, valid: np.ndarray,
-                    k: int, fraglen: int,
-                    subsample_c: int) -> GenomeProfile:
+                    k: int, fraglen: int, subsample_c: int,
+                    pos: Optional[np.ndarray] = None) -> GenomeProfile:
     """Distinct set + marker slice + construction — the one tail
-    shared by the C single-pass and generic profile builds."""
+    shared by the C single-pass and generic profile builds. `pos`
+    (the kept hashes' positions, when the C profile walk produced
+    them) enables the O(n_valid) window assembly."""
     ref_set = np.unique(valid)
     markers = ref_set[ref_set < np.uint64((1 << 64) // MARKER_C)]
     return GenomeProfile(
         path=path, k=k, fraglen=fraglen,
         flat_hashes=flat, ref_set=ref_set, markers=markers,
-        subsample_c=subsample_c)
+        subsample_c=subsample_c,
+        _kept_hashes=valid if pos is not None else None,
+        _kept_pos=pos)
 
 
 def _profile_from_flat(path: str, flat: np.ndarray, k: int, fraglen: int,
@@ -293,11 +312,18 @@ def _profile_via_c(genome: Genome, k: int, fraglen: int,
     _c_profile_available first."""
     from galah_tpu.ops import _csketch
 
-    cut = 0 if subsample_c == 1 else (1 << 64) // subsample_c
-    flat, valid = _csketch.positional_hashes_masked(
+    if subsample_c == 1:
+        # dense profile: windows() uses the flat layout directly, so
+        # the kept-positions array would be 8 B/bp of dead weight
+        flat, valid = _csketch.positional_hashes_masked(
+            genome.codes, genome.contig_offsets, k=k, cut=0, algo=algo)
+        return _finish_profile(genome.path, flat, valid, k, fraglen,
+                               subsample_c)
+    cut = (1 << 64) // subsample_c
+    flat, valid, pos = _csketch.positional_hashes_profile(
         genome.codes, genome.contig_offsets, k=k, cut=cut, algo=algo)
     return _finish_profile(genome.path, flat, valid, k, fraglen,
-                           subsample_c)
+                           subsample_c, pos=pos)
 
 
 def build_profile(genome: Genome, k: int, fraglen: int,
@@ -916,14 +942,24 @@ def bidirectional_ani_values(
     identical Nones/floats either way — the gate arithmetic is the
     same f64 ops _combine_bidirectional runs on ints)."""
     n = len(pairs)
-    directed = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
-    # Gate on the DIRECTED list — the same list (and therefore the
-    # same worthwhile/uniform decision) the bidirectional_ani_batch
-    # fallback's inner directed_ani_batch would gate on, so the two
-    # entries never disagree about the C batch path.
+    # Gate exactly as the fallback's inner directed_ani_batch would on
+    # the doubled directed list — but WITHOUT materializing that list
+    # (2n tuples is hundreds of MB at mega-pair volumes) unless the
+    # arrays path is actually taken: in the bidirectional list every
+    # genome appears in both roles, so the concat estimate is each
+    # unique genome's query-role plus ref-role contribution.
+    seen: "set[int]" = set()
+    est = 0
+    for a, b in pairs:
+        for p in (a, b):
+            if id(p) not in seen:
+                seen.add(id(p))
+                est += (p.flat_hashes.shape[0]
+                        // max(p.subsample_c, 1))
+                est += p.ref_set.shape[0]
     use_arrays = (
         jax.default_backend() == "cpu" and jax.device_count() == 1
-        and _batch_path_worthwhile(directed)
+        and 2 * n >= 64 and est <= _MERGE_BATCH_CONCAT_CAP
         and len({(p.k, p.fraglen, p.subsample_c)
                  for pair in pairs for p in pair}) == 1)
     if use_arrays:
@@ -937,6 +973,8 @@ def bidirectional_ani_values(
         return [ani for ani, _, _ in bidirectional_ani_batch(
             pairs, min_aligned_frac, identity_floor=identity_floor,
             threads=threads)]
+
+    directed = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
     ani, _af, fm, ft = _directed_ani_arrays_c(
         directed, identity_floor, DEFAULT_MIN_WINDOW_VALID_FRAC,
         threads)

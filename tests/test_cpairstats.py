@@ -351,3 +351,58 @@ def test_window_match_counts_merge_parity():
             qh[order], rows[order].astype(np.int32), W, ref)
         np.testing.assert_array_equal(got_m, want_m)
         np.testing.assert_array_equal(totals, want_t)
+
+
+def test_windows_from_pairs_matches_compact_windows():
+    """The O(n_valid) pair-based window assembly (profile-walk pos
+    output) is bit-identical to compact_windows on the same flat
+    array — incl. boundary-crossing drops, ragged last window, and
+    the slots rounding."""
+    import numpy as np
+
+    from galah_tpu.ops import _cpairstats
+    from galah_tpu.ops.constants import SENTINEL
+
+    rng = np.random.default_rng(7)
+    L, k = 300, 21
+    flat = rng.integers(0, 1 << 64, size=2 * L + 57, dtype=np.uint64)
+    keep = rng.random(flat.shape[0]) < 0.2
+    flat[~keep] = np.uint64(SENTINEL)
+    w = -(-flat.shape[0] // L)
+
+    want = _cpairstats.compact_windows(flat, w, L, k)
+    pos = np.nonzero(flat != np.uint64(SENTINEL))[0].astype(np.int64)
+    got = _cpairstats.windows_from_pairs(
+        pos, flat[pos], w, L, k)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_profile_via_c_pairs_path_windows_parity(tmp_path):
+    """A profile built by the new positional_hashes_profile walk
+    (kept pairs stored) produces the same windows()/sorted_query()
+    as one forced through the compact_windows fallback."""
+    import numpy as np
+
+    from galah_tpu.io.fasta import read_genome
+    from galah_tpu.ops import fragment_ani
+
+    rng = np.random.default_rng(3)
+    seq = rng.choice(list(b"ACGT"), size=50_000).astype(np.uint8)
+    p = tmp_path / "g.fna"
+    p.write_bytes(b">c1\n" + seq.tobytes() + b"\n")
+    g = read_genome(str(p))
+    prof = fragment_ani.build_profile(g, k=21, fraglen=3000,
+                                      subsample_c=16)
+    if prof._kept_pos is None:
+        pytest.skip("C profile walk unavailable on this backend")
+    wins_pairs = prof.windows()
+    sq_pairs = prof.sorted_query()
+
+    prof2 = fragment_ani.build_profile(g, k=21, fraglen=3000,
+                                       subsample_c=16)
+    prof2._kept_pos = None
+    prof2._kept_hashes = None
+    wins_flat = prof2.windows()
+    np.testing.assert_array_equal(wins_pairs, wins_flat)
+    for a, b in zip(sq_pairs, prof2.sorted_query()):
+        np.testing.assert_array_equal(a, b)
