@@ -395,3 +395,4 @@ int64_t galah_positional_hashes_profile(
     *n_valid_out = nv;
     return n - k + 1;
 }
+

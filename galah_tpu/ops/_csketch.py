@@ -206,3 +206,4 @@ def positional_hashes_profile(
         ctypes.byref(n_valid))
     nv = n_valid.value
     return (out[:max(got, 0)], valid[:nv].copy(), pos[:nv].copy())
+

@@ -266,6 +266,9 @@ def _finish_profile(path: str, flat: np.ndarray, valid: np.ndarray,
     shared by the C single-pass and generic profile builds. `pos`
     (the kept hashes' positions, when the C profile walk produced
     them) enables the O(n_valid) window assembly."""
+    # np.unique stays: numpy's u64 sort is radix-backed and measured
+    # 4x FASTER than the inlined C quicksort on 3M-hash inputs
+    # (74 vs 287 ms, 2026-07-31) — a C dedup here is a pessimization.
     ref_set = np.unique(valid)
     markers = ref_set[ref_set < np.uint64((1 << 64) // MARKER_C)]
     return GenomeProfile(
