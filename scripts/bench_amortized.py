@@ -103,12 +103,18 @@ def main():
     args = ap.parse_args()
 
     import jax
+
+    interpret = args.interpret
+    if interpret:
+        # CPU smoke must not touch the (possibly wedged) TPU tunnel;
+        # the env var alone is overridden by the axon sitecustomize
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     from galah_tpu.ops.pallas_pairlist import pair_stats_pairs_pallas
     from galah_tpu.ops.pallas_pairwise import tile_stats_pallas
 
-    interpret = args.interpret
     if not interpret:
         assert jax.default_backend() == "tpu", jax.default_backend()
 
