@@ -319,7 +319,8 @@ void galah_window_match_counts_merge(
     for (int64_t i = 0; i < nq; i++) {
         uint64_t h = qh[i];
         while (r < H && ref[r] < h) r++;
-        if (r < H && ref[r] == h) matched[qw[i]]++;
+        /* branchless increment — see the batch worker's note */
+        matched[qw[i]] += (int32_t)(r < H && ref[r] == h);
     }
 }
 
@@ -365,7 +366,11 @@ static void *wmb_worker(void *arg) {
         for (int64_t i = 0; i < nq; i++) {
             uint64_t h = qh[i];
             while (r < H && ref[r] < h) r++;
-            if (r < H && ref[r] == h) matched[qw[i]]++;
+            /* branchless: in the dense-similarity regime ~all query
+             * hashes match, in the sparse regime ~none — either way
+             * the compare-to-increment is cheaper than a data-
+             * dependent branch */
+            matched[qw[i]] += (int32_t)(r < H && ref[r] == h);
         }
     }
     return NULL;
