@@ -1,0 +1,24 @@
+#!/bin/bash
+# Round-long watcher: restart tpu_validation_run.sh whenever it gives up
+# (60 failed probes = ~2h window) so the tunnel is probed all round.
+# A successful run leaves its captures in docs/artifacts/tpu_watch_*/ and
+# a sentinel file so the builder notices and commits them.
+set -u
+LOG=/root/repo/scripts/tpu_validation.log
+while true; do
+  if bash /root/repo/scripts/tpu_validation_run.sh; then
+    # A zero exit only means a probe attached; run_stage swallows stage
+    # failures. Declare the capture done only if the bench stage itself
+    # exited 0 — otherwise keep probing (the tunnel may have flapped).
+    ART=$(ls -dt /root/repo/docs/artifacts/tpu_watch_* 2>/dev/null | head -1)
+    if [ -n "$ART" ] && grep -q -- "--- exit 0" "$ART/bench.txt" 2>/dev/null; then
+      touch /root/repo/scripts/TPU_CAPTURE_DONE
+      echo "=== watch_loop: capture complete ($ART) $(date -u) ===" >> "$LOG"
+      exit 0
+    fi
+    echo "=== watch_loop: probe attached but bench stage failed ($ART), re-probing $(date -u) ===" >> "$LOG"
+  else
+    echo "=== watch_loop: window exhausted, restarting $(date -u) ===" >> "$LOG"
+  fi
+  sleep 30
+done

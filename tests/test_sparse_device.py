@@ -172,6 +172,99 @@ def test_sparse_device_equals_c_kernel_at_scale():
     assert len(via_c) > 1000
 
 
+class _LazyFail:
+    """A device-future stand-in whose host materialization raises — the
+    settle-time Mosaic failure shape (dispatch enqueues fine; the error
+    surfaces when the ordered sync reads the buffer back)."""
+
+    def __array__(self, dtype=None, copy=None):
+        raise RuntimeError("injected Mosaic runtime failure (settle)")
+
+
+def _inject_mosaic_failure(monkeypatch, mode, fail_at, log):
+    """Patch the module-level _batch_pair_stats with a fake whose pallas
+    path computes the real XLA integers (the two paths are bit-identical
+    by contract) but fails on the fail_at-th pallas dispatch — raising at
+    enqueue, or returning lazily-failing buffers for the settle site.
+    Every call appends ("pallas"|"xla") to `log`."""
+    real = sparse_device._batch_pair_stats
+
+    def fake(jmat, pi, pj, sketch_size, use_pallas=False, interpret=False):
+        exact = real(jmat, pi, pj, sketch_size=sketch_size,
+                     use_pallas=False, interpret=False)
+        if not use_pallas:
+            log.append("xla")
+            return exact
+        n_before = log.count("pallas")
+        log.append("pallas")
+        if n_before == fail_at:
+            if mode == "enqueue":
+                raise RuntimeError(
+                    "injected Mosaic runtime failure (enqueue)")
+            return _LazyFail(), _LazyFail()
+        return exact
+
+    monkeypatch.setattr(sparse_device, "_batch_pair_stats", fake)
+    import galah_tpu.ops.hll as hll
+
+    monkeypatch.setattr(hll, "use_pallas_default", lambda: True)
+
+
+def _fault_pairs(n=240, n_pairs=600, seed=3):
+    mat = _family_sketches(n=n, n_fam=24, seed=seed)
+    rng = np.random.default_rng(seed)
+    pi = rng.integers(0, n - 1, size=n_pairs).astype(np.int64)
+    pj = np.minimum(pi + 1 + rng.integers(0, 40, size=n_pairs), n - 1)
+    return mat, pi, pj
+
+
+@pytest.mark.parametrize("mode", ["enqueue", "settle"])
+def test_mosaic_midstream_failure_downgrades_once(monkeypatch, mode):
+    """A Mosaic runtime failure mid-pipeline — at dispatch enqueue or at
+    host materialization of an in-flight batch — must downgrade the run
+    to the XLA path exactly once and still produce integers bit-identical
+    to a pure-XLA run (the downgrade_and_redo contract,
+    ops/sparse_device.py). Analog of the reference's finish_command_safely
+    fail-safe (reference: src/dashing.rs:101)."""
+    mat, pi, pj = _fault_pairs()
+    want_c, want_t = pair_stats_for_pairs(
+        mat, pi, pj, mat.shape[1], batch=32, use_pallas=False)
+
+    log = []
+    _inject_mosaic_failure(monkeypatch, mode, fail_at=5, log=log)
+    got_c, got_t = pair_stats_for_pairs(mat, pi, pj, mat.shape[1],
+                                        batch=32)
+    np.testing.assert_array_equal(got_c, want_c)
+    np.testing.assert_array_equal(got_t, want_t)
+
+    # The failing batch ran on pallas; everything after the failure ran
+    # XLA-only — a single pallas->xla transition, never a re-upgrade.
+    assert log.count("pallas") >= 6  # batch 0 + pipeline up to the fault
+    assert "xla" in log
+    first_xla = log.index("xla")
+    assert all(p == "xla" for p in log[first_xla:]), \
+        "pallas dispatch after the downgrade: use_pallas re-upgraded"
+    # Enqueue-time failure is detected immediately: the faulting call is
+    # the last pallas dispatch. (Settle-time surfaces only when the
+    # ordered sync drains the batch, so later pallas enqueues are
+    # expected there.)
+    if mode == "enqueue":
+        assert log.index("xla") == 6
+
+
+@pytest.mark.parametrize("mode", ["enqueue", "settle"])
+def test_mosaic_midstream_failure_explicit_pin_raises(monkeypatch, mode):
+    """With use_pallas pinned explicitly, a mid-stream Mosaic failure
+    must propagate — parity tests must never silently compare XLA to
+    XLA (ops/_fallback.py policy)."""
+    mat, pi, pj = _fault_pairs(seed=11)
+    log = []
+    _inject_mosaic_failure(monkeypatch, mode, fail_at=3, log=log)
+    with pytest.raises(RuntimeError, match="injected Mosaic"):
+        pair_stats_for_pairs(mat, pi, pj, mat.shape[1], batch=32,
+                             use_pallas=True)
+
+
 def test_dispatch_counters_recorded(monkeypatch):
     """The sparse device pipeline records disp/sync counters under the
     active stage — the per-stage round-trip visibility the TPU e2e
