@@ -24,6 +24,11 @@
 #include <stdint.h>
 #include <stdlib.h>
 
+#if defined(__x86_64__) && defined(__GNUC__)
+#include <immintrin.h>
+#define GALAH_HAVE_AVX512_BUILD 1
+#endif
+
 typedef struct {
     const uint64_t *mat;
     const int64_t *lens;
@@ -312,9 +317,9 @@ void galah_fill_compact_windows(const uint64_t *flat, int64_t n_flat,
  * are pair-independent (per-window valid counts) and are computed by
  * the caller once per profile. Bit-identical matched counts to
  * galah_window_match_counts on the same windows. */
-void galah_window_match_counts_merge(
-    const uint64_t *qh, const int32_t *qw, int64_t nq,
-    const uint64_t *ref, int64_t H, int32_t *matched) {
+static void merge_count_scalar(const uint64_t *qh, const int32_t *qw,
+                               int64_t nq, const uint64_t *ref,
+                               int64_t H, int32_t *matched) {
     int64_t r = 0;
     for (int64_t i = 0; i < nq; i++) {
         uint64_t h = qh[i];
@@ -322,6 +327,103 @@ void galah_window_match_counts_merge(
         /* branchless increment — see the batch worker's note */
         matched[qw[i]] += (int32_t)(r < H && ref[r] == h);
     }
+}
+
+#ifdef GALAH_HAVE_AVX512_BUILD
+/* AVX-512 block merge: compare 8-element query blocks against
+ * 8-element ref blocks, all 64 lane combinations per block pair via 7
+ * in-register rotations (valignq) + cmpeq, then advance the block
+ * whose max is smaller. Ties advance the QUERY block only — the ref
+ * block holding the equal element stays resident, so query duplicates
+ * in later blocks still see it (ref values are distinct, query values
+ * need not be). Match bits accumulate per query block and are flushed
+ * as matched[qw[...]] increments at block retirement; the masked
+ * flush preserves exact per-window counts. Scalar tails finish the
+ * sub-block remainders from the block cursors — safe because every
+ * retired ref block's max is strictly below some retired query max,
+ * so no remaining query element can equal a retired ref element.
+ * Bit-identical to merge_count_scalar by construction (and pinned by
+ * tests/test_cpairstats.py across regimes and odd sizes). */
+__attribute__((target("avx512f")))
+static void merge_count_avx512(const uint64_t *qh, const int32_t *qw,
+                               int64_t nq, const uint64_t *ref,
+                               int64_t H, int32_t *matched) {
+    int64_t qi = 0, ri = 0;
+    const int64_t nqb = nq & ~(int64_t)7, nrb = H & ~(int64_t)7;
+    if (nqb > 0 && nrb > 0) {
+        __m512i qv = _mm512_loadu_si512((const void *)(qh + qi));
+        __m512i rv = _mm512_loadu_si512((const void *)(ref + ri));
+        unsigned m = 0;
+        for (;;) {
+            m |= (unsigned)_mm512_cmpeq_epu64_mask(qv, rv);
+            m |= (unsigned)_mm512_cmpeq_epu64_mask(
+                qv, _mm512_alignr_epi64(rv, rv, 1));
+            m |= (unsigned)_mm512_cmpeq_epu64_mask(
+                qv, _mm512_alignr_epi64(rv, rv, 2));
+            m |= (unsigned)_mm512_cmpeq_epu64_mask(
+                qv, _mm512_alignr_epi64(rv, rv, 3));
+            m |= (unsigned)_mm512_cmpeq_epu64_mask(
+                qv, _mm512_alignr_epi64(rv, rv, 4));
+            m |= (unsigned)_mm512_cmpeq_epu64_mask(
+                qv, _mm512_alignr_epi64(rv, rv, 5));
+            m |= (unsigned)_mm512_cmpeq_epu64_mask(
+                qv, _mm512_alignr_epi64(rv, rv, 6));
+            m |= (unsigned)_mm512_cmpeq_epu64_mask(
+                qv, _mm512_alignr_epi64(rv, rv, 7));
+            if (ref[ri + 7] < qh[qi + 7]) {
+                ri += 8;
+                if (ri >= nrb) break;
+                rv = _mm512_loadu_si512((const void *)(ref + ri));
+            } else {
+                while (m) {
+                    int l = __builtin_ctz(m);
+                    matched[qw[qi + l]]++;
+                    m &= m - 1;
+                }
+                qi += 8;
+                if (qi >= nqb) break;
+                qv = _mm512_loadu_si512((const void *)(qh + qi));
+            }
+        }
+        /* ref-exhausted exit leaves the current query block's bits
+         * unflushed (query-exhausted exit left m == 0) */
+        while (m) {
+            int l = __builtin_ctz(m);
+            matched[qw[qi + l]]++;
+            m &= m - 1;
+        }
+    }
+    /* scalar tails: double counting is impossible — a lane counted by
+     * the mask matched a distinct ref value at index < ri, which the
+     * offset scalar walk (equivalent to starting at r = ri) never
+     * revisits */
+    merge_count_scalar(qh + qi, qw + qi, nq - qi, ref + ri, H - ri,
+                       matched);
+}
+#endif
+
+typedef void (*merge_count_t)(const uint64_t *, const int32_t *,
+                              int64_t, const uint64_t *, int64_t,
+                              int32_t *);
+
+/* Resolve the dispatch ONCE per public entry (not per pair — the
+ * batched path exists because pair volume reaches N^2/2, and a getenv
+ * environ scan per pair from concurrent threads is pure overhead).
+ * Re-resolving per entry keeps GALAH_TPU_NO_AVX512 togglable within a
+ * process (the A/B tests rely on that). */
+static merge_count_t merge_count_resolve(void) {
+#ifdef GALAH_HAVE_AVX512_BUILD
+    if (__builtin_cpu_supports("avx512f") &&
+        !getenv("GALAH_TPU_NO_AVX512"))
+        return merge_count_avx512;
+#endif
+    return merge_count_scalar;
+}
+
+void galah_window_match_counts_merge(
+    const uint64_t *qh, const int32_t *qw, int64_t nq,
+    const uint64_t *ref, int64_t H, int32_t *matched) {
+    merge_count_resolve()(qh, qw, nq, ref, H, matched);
 }
 
 /* Batched sorted-merge membership counter: the per-PAIR-LIST twin of
@@ -354,6 +456,7 @@ typedef struct {
 
 static void *wmb_worker(void *arg) {
     wmb_job *w = (wmb_job *)arg;
+    merge_count_t mc = merge_count_resolve(); /* once per worker */
     for (int64_t p = w->tid; p < w->n_pairs; p += w->n_threads) {
         int64_t qg = w->pair_q[p], rg = w->pair_r[p];
         const uint64_t *qh = w->qh_cat + w->q_off[qg];
@@ -362,16 +465,12 @@ static void *wmb_worker(void *arg) {
         const uint64_t *ref = w->ref_cat + w->r_off[rg];
         int64_t H = w->r_off[rg + 1] - w->r_off[rg];
         int32_t *matched = w->matched_cat + w->m_off[p];
-        int64_t r = 0;
-        for (int64_t i = 0; i < nq; i++) {
-            uint64_t h = qh[i];
-            while (r < H && ref[r] < h) r++;
-            /* branchless: in the dense-similarity regime ~all query
-             * hashes match, in the sparse regime ~none — either way
-             * the compare-to-increment is cheaper than a data-
-             * dependent branch */
-            matched[qw[i]] += (int32_t)(r < H && ref[r] == h);
-        }
+        /* AVX-512 block merge when the CPU has it, scalar walk
+         * otherwise (branchless increment: in the dense-similarity
+         * regime ~all query hashes match, in the sparse regime ~none —
+         * either way compare-to-increment beats a data-dependent
+         * branch) */
+        mc(qh, qw, nq, ref, H, matched);
     }
     return NULL;
 }
