@@ -349,6 +349,53 @@ def _synth_families(n_genomes=48, genome_len=60_000, n_families=12,
     return paths
 
 
+def _synth_repeat_genomes(n_genomes=64, genome_len=100_000,
+                          repeat_frac=0.3, n_elements=8,
+                          element_len=2000, seed=23, outdir=None):
+    """UNRELATED genomes sharing mobile-element-like repeat content —
+    the collision screen's adversarial case (uniform-random rungs are
+    its best case). Every genome is an independent random backbone
+    with ~repeat_frac of its length replaced by elements drawn from
+    ONE shared pool of n_elements sequences (element_len bp each), at
+    random positions. Genomes therefore share k-mers (the screen sees
+    collisions) without sharing ancestry (true ANI across genomes is
+    driven by the repeat fraction alone). Returns FASTA paths.
+    """
+    import atexit
+    import shutil
+    import tempfile
+
+    rng = np.random.default_rng(seed)
+    if outdir is None:
+        outdir = tempfile.mkdtemp(prefix="galah_repeat_")
+        atexit.register(shutil.rmtree, outdir, ignore_errors=True)
+    alphabet = np.frombuffer(b"ACGT", dtype=np.uint8)
+    pool = [rng.integers(0, 4, size=element_len)
+            for _ in range(n_elements)]
+    n_ins = max(int(round(genome_len * repeat_frac / element_len)), 0)
+    paths = []
+    for g in range(n_genomes):
+        backbone_len = genome_len - n_ins * element_len
+        backbone = rng.integers(0, 4, size=max(backbone_len, 0))
+        # splice elements between backbone chunks at random cut points
+        cuts = np.sort(rng.integers(0, max(backbone.shape[0], 1),
+                                    size=n_ins))
+        parts, prev = [], 0
+        for c, e in zip(cuts, rng.integers(0, n_elements, size=n_ins)):
+            parts.append(backbone[prev:c])
+            parts.append(pool[int(e)])
+            prev = c
+        parts.append(backbone[prev:])
+        seq = np.concatenate(parts) if parts else backbone
+        p = os.path.join(outdir, f"rep{g}.fna")
+        with open(p, "wb") as fh:
+            fh.write(b">contig1\n")
+            fh.write(alphabet[seq].tobytes())
+            fh.write(b"\n")
+        paths.append(p)
+    return paths
+
+
 def bench_e2e(fast=False, paths=None):
     """Full cluster() wall-clock on planted families -> genomes/s.
 
@@ -371,6 +418,66 @@ def bench_e2e(fast=False, paths=None):
     dt = time.perf_counter() - t0
     assert 1 <= len(clusters) <= len(paths)
     return len(paths) / dt, len(clusters), paths
+
+
+def run_ladder_stages(stages, errors):
+    """North-star-relevant e2e evidence in the driver artifact itself.
+
+    Two rungs, each with a sibling `_workload` key stating exactly what
+    the number means (the workload shape changes the number more than
+    the code does, so the artifact must say what was run):
+
+      * e2e_1000_genomes_per_sec — cluster() on 1000 synthetic genomes
+        with planted family structure (250 families x 4 members, 3%
+        mutation, 100 kbp) at the DEFAULT config (murmur3 hashes,
+        finch-style precluster + skani-style cluster). The BASELINE.md
+        ladder's rung-2 class at N=1000, inside the driver artifact.
+      * mega_256_genomes_per_sec — the dense-similarity worst case the
+        reference advertises ("many closely related genomes >95% ANI",
+        reference: README.md:18-26): ONE planted family of 256, every
+        pair ~96% ANI, through the default skani+skani path. Nothing
+        screens out; the exact-ANI stage does all-pairs work.
+
+    Runs on whatever backend the caller already initialized (device or
+    pinned CPU) — the JSON's `backend` field disambiguates.
+    """
+    from galah_tpu.api import generate_galah_clusterer
+
+    def run_one(key, paths, values, workload):
+        t0 = time.perf_counter()
+        clusterer = generate_galah_clusterer(paths, values)
+        clusters = clusterer.cluster()
+        dt = time.perf_counter() - t0
+        stages[key + "_genomes_per_sec"] = round(len(paths) / dt, 2)
+        stages[key + "_n_clusters"] = len(clusters)
+        stages[key + "_workload"] = workload
+
+    base = {"ani": 95.0, "precluster_ani": 90.0,
+            "min_aligned_fraction": 15.0, "fragment_length": 3000,
+            "precluster_method": "finch", "cluster_method": "skani",
+            "threads": 1}
+    try:
+        with watchdog(900):
+            paths = _synth_families(n_genomes=1000, genome_len=100_000,
+                                    n_families=250, mut=0.03, seed=11)
+            run_one("e2e_1000", paths, dict(base),
+                    "1000 synthetic genomes, 250 planted families x4, "
+                    "3% mutation, 100 kbp, default murmur3 finch+skani")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"e2e_1000: {type(e).__name__}: {e}")
+    try:
+        with watchdog(900):
+            paths = _synth_families(n_genomes=256, genome_len=100_000,
+                                    n_families=1, mut=0.02, seed=11)
+            mega = dict(base)
+            mega.update(precluster_method="skani",
+                        cluster_method="skani")
+            run_one("mega_256", paths, mega,
+                    "dense worst case: ONE planted family of 256, "
+                    "every pair ~96% ANI, 100 kbp, default skani+skani "
+                    "(nothing screens out)")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"mega_256: {type(e).__name__}: {e}")
 
 
 def main():
@@ -457,6 +564,7 @@ def main():
                 stages["e2e_fast_n_clusters"] = nc
         except Exception as e:  # noqa: BLE001
             errors.append(f"e2e-fallback: {type(e).__name__}: {e}")
+        run_ladder_stages(stages, errors)
         print(json.dumps(result))
         return
 
@@ -569,6 +677,10 @@ def main():
             stages["e2e_fast_n_clusters"] = n_clusters
     except Exception as e:  # noqa: BLE001
         errors.append(f"e2e-fast: {type(e).__name__}: {e}")
+
+    # 7. North-star ladder rungs (N=1000 e2e + dense mega regime) in
+    # the driver artifact, whatever the backend.
+    run_ladder_stages(stages, errors)
 
     print(json.dumps(result))
 

@@ -38,6 +38,14 @@ def main() -> None:
                     help="also run the quality-ordered rung: synthetic "
                          "CheckM2 report + Parks2020_reduced ranking "
                          "(BASELINE.json rung-4 semantics)")
+    ap.add_argument("--repeat-frac", type=float, default=0.0,
+                    help="rung 2 becomes the adversarial repeat rung: "
+                         "UNRELATED genomes sharing this fraction of "
+                         "mobile-element content from one pool "
+                         "(bench._synth_repeat_genomes) — the "
+                         "collision screen's worst case, for "
+                         "wall-clock comparison against the uniform "
+                         "rung at equal N*bp")
     ap.add_argument("--mega", action="store_true",
                     help="dense-similarity worst case: ONE planted "
                          "mega-family (every pair >95%% ANI) through "
@@ -47,6 +55,9 @@ def main() -> None:
                          "(reference: README.md:18-26). Replaces "
                          "rung 2; --n sets the family size.")
     args = ap.parse_args()
+    if args.mega and args.repeat_frac > 0:
+        ap.error("--mega and --repeat-frac are mutually exclusive "
+                 "(each replaces rung 2 with a different workload)")
 
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -116,6 +127,12 @@ def main() -> None:
         values["precluster_method"] = "skani"
         values["cluster_method"] = "skani"
         run(f"rung-mega-{args.n}", paths, values)
+    elif args.repeat_frac > 0:
+        paths = bench._synth_repeat_genomes(
+            n_genomes=args.n, genome_len=args.genome_len,
+            repeat_frac=args.repeat_frac, seed=23)
+        run(f"rung-repeat{args.repeat_frac:g}-{args.n}", paths,
+            dict(base_values))
     else:
         n_fam = max(args.n // 4, 1)
         paths = bench._synth_families(
