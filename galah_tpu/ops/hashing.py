@@ -33,11 +33,12 @@ _C1 = jnp.uint64(0x87C37B91114253D5)
 _C2 = jnp.uint64(0x4CF5AD432745937F)
 
 # Mosaic murmur3 state-machine default on TPU backends when
-# GALAH_TPU_PALLAS_HASH is unset. Set from hardware data ONLY: the
-# amortized on-chip campaign (scripts/bench_amortized.py, murmur
-# verdict row) flips this to True if the Mosaic kernel beats the XLA
-# emulation >= 1.1x on-chip. Tunnel-bound measurements (round 3:
-# 1.00x, dispatch-bound) do not qualify.
+# GALAH_TPU_PALLAS_HASH is unset. DECIDED from hardware data,
+# 2026-08-01 amortized on-chip campaign (scripts/bench_amortized.py,
+# docs/artifacts/tpu_watch_20260801_0829/amortized.txt): Mosaic/XLA =
+# 0.06x at n=2Mi hashes (650 M/s vs 10.9 G/s amortized) — the XLA
+# emulation wins decisively on-chip, not just through the tunnel, so
+# the default stays False. Re-run the campaign before revisiting.
 _PALLAS_HASH_TPU_DEFAULT = False
 
 

@@ -184,7 +184,14 @@ def pair_stats_pairs_pallas(
     """(common, total) int32 (B,) for each (rows_a[p], rows_b[p]) pair
     — the Mosaic twin of the vmapped ops/pairwise._pair_stats used by
     the screened sparse pipeline. Bit-identical integers (either
-    range_skip setting; see _make_kernel)."""
+    range_skip setting; see _make_kernel).
+
+    range_skip stays False by default — DECIDED from hardware:
+    the 2026-08-01 amortized on-chip campaign measured the skip
+    variant 3.2x SLOWER (62.8k -> 19.5k pairs/s at B=8192;
+    docs/artifacts/tpu_watch_20260801_0829/amortized.txt) — the
+    data-dependent `pl.when` breaks Mosaic's pipelining on v5e and
+    costs more than the skipped compares save."""
     b_in, k_in = rows_a.shape
     if b_in == 0:
         z = jnp.zeros((0,), jnp.int32)

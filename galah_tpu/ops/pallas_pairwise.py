@@ -301,7 +301,12 @@ def tile_stats_pallas(
     ops/pairwise.tile_stats (bit-identical integers). With `intersect`,
     `common` is the raw |row ∩ col| count (the twin of
     ops/pairwise.tile_intersect_counts) and `total` the row's valid
-    count."""
+    count.
+
+    range_skip stays False by default — DECIDED from hardware: the
+    2026-08-01 amortized on-chip campaign measured the skip variant
+    3.7x SLOWER on the dense tile (218.1k -> 59.4k pairs/s at
+    512x512; docs/artifacts/tpu_watch_20260801_0829/amortized.txt)."""
     br_in, k_in = rows.shape
     bc_in = cols.shape[0]
     sent = ~jnp.uint64(0)
