@@ -446,6 +446,16 @@ def _admit(cost_s, label, errors) -> bool:
     return True
 
 
+def _admitted_watchdog(cost_s, label, errors):
+    """One cost figure drives BOTH the admission check and the
+    watchdog, so the two cannot drift apart: returns a watchdog
+    context for cost_s, or None when the stage does not fit (the
+    skip is recorded)."""
+    if not _admit(cost_s, label, errors):
+        return None
+    return watchdog(cost_s)
+
+
 def run_ladder_stages(stages, errors):
     """North-star-relevant e2e evidence in the driver artifact itself.
 
@@ -482,9 +492,10 @@ def run_ladder_stages(stages, errors):
             "min_aligned_fraction": 15.0, "fragment_length": 3000,
             "precluster_method": "finch", "cluster_method": "skani",
             "threads": 1}
-    if _admit(900, "e2e_1000", errors):
+    wd = _admitted_watchdog(900, "e2e_1000", errors)
+    if wd:
         try:
-            with watchdog(900):
+            with wd:
                 paths = _synth_families(
                     n_genomes=1000, genome_len=100_000,
                     n_families=250, mut=0.03, seed=11)
@@ -494,10 +505,11 @@ def run_ladder_stages(stages, errors):
                         "finch+skani")
         except Exception as e:  # noqa: BLE001
             errors.append(f"e2e_1000: {type(e).__name__}: {e}")
-    if not _admit(900, "mega_256", errors):
+    wd = _admitted_watchdog(900, "mega_256", errors)
+    if not wd:
         return
     try:
-        with watchdog(900):
+        with wd:
             paths = _synth_families(n_genomes=256, genome_len=100_000,
                                     n_families=1, mut=0.02, seed=11)
             mega = dict(base)
@@ -657,26 +669,26 @@ def main():
     # fori_loop repeats inside one dispatch): the MFU measurement that
     # separates kernel speed from tunnel dispatch/transfer. Subprocess
     # so a wedge mid-campaign cannot take down the bench line.
-    if not _admit(900, "amortized", errors):
-        print(json.dumps(result))
-        return
-    try:
-        here = os.path.dirname(os.path.abspath(__file__))
-        proc = subprocess.run(
-            [sys.executable,
-             os.path.join(here, "scripts", "bench_amortized.py"),
-             "--fast"],
-            capture_output=True, text=True, timeout=900, cwd=here)
-        amort = None
-        for line in proc.stdout.splitlines():
-            if line.startswith("AMORTIZED_JSON "):
-                amort = json.loads(line[len("AMORTIZED_JSON "):])
-        if amort is None:
-            raise RuntimeError(
-                f"rc={proc.returncode}: {proc.stderr[-400:]}")
-        stages["amortized_on_chip"] = amort
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"amortized: {type(e).__name__}: {e}")
+    _AMORT_COST = 900
+    if _admit(_AMORT_COST, "amortized", errors):
+        try:
+            here = os.path.dirname(os.path.abspath(__file__))
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(here, "scripts", "bench_amortized.py"),
+                 "--fast"],
+                capture_output=True, text=True, timeout=_AMORT_COST,
+                cwd=here)
+            amort = None
+            for line in proc.stdout.splitlines():
+                if line.startswith("AMORTIZED_JSON "):
+                    amort = json.loads(line[len("AMORTIZED_JSON "):])
+            if amort is None:
+                raise RuntimeError(
+                    f"rc={proc.returncode}: {proc.stderr[-400:]}")
+            stages["amortized_on_chip"] = amort
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"amortized: {type(e).__name__}: {e}")
 
     # 5. Sketching throughput on real FASTA bytes, both hash algos —
     # each with its own watchdog so one failure never loses the other.
@@ -685,18 +697,20 @@ def main():
     # 240 s — the budget must cover compiles, not just compute.
     for algo, key in (("murmur3", "sketch_bp_per_sec"),
                       ("tpufast", "sketch_tpufast_bp_per_sec")):
-        if not _admit(600, f"sketching-{algo}", errors):
+        wd = _admitted_watchdog(600, f"sketching-{algo}", errors)
+        if not wd:
             continue
         try:
-            with watchdog(600):
+            with wd:
                 bps = bench_sketching(algo)
                 if bps:
                     stages[key] = round(bps, 1)
         except Exception as e:  # noqa: BLE001
             errors.append(f"sketching-{algo}: {type(e).__name__}: {e}")
-    if _admit(600, "sketching-batch", errors):
+    wd = _admitted_watchdog(600, "sketching-batch", errors)
+    if wd:
         try:
-            with watchdog(600):
+            with wd:
                 bps = bench_sketching_batch("murmur3")
                 if bps:
                     stages["sketch_batch_bp_per_sec"] = round(bps, 1)
@@ -706,17 +720,19 @@ def main():
     # 6. End-to-end cluster() on planted families, default and fast
     # mode (each with its own watchdog).
     paths = None
-    if _admit(300, "e2e", errors):
+    wd = _admitted_watchdog(300, "e2e", errors)
+    if wd:
         try:
-            with watchdog(300):
+            with wd:
                 gps, n_clusters, paths = bench_e2e()
                 stages["e2e_genomes_per_sec"] = round(gps, 2)
                 stages["e2e_n_clusters"] = n_clusters
         except Exception as e:  # noqa: BLE001
             errors.append(f"e2e: {type(e).__name__}: {e}")
-    if _admit(300, "e2e-fast", errors):
+    wd = _admitted_watchdog(300, "e2e-fast", errors)
+    if wd:
         try:
-            with watchdog(300):
+            with wd:
                 gps, n_clusters, _ = bench_e2e(fast=True, paths=paths)
                 stages["e2e_fast_genomes_per_sec"] = round(gps, 2)
                 stages["e2e_fast_n_clusters"] = n_clusters

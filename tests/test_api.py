@@ -69,7 +69,14 @@ def test_end_to_end_via_api(ref_data):
     assert sorted(sorted(c) for c in out) == [[0, 1, 3], [2]]
 
 
-@pytest.mark.parametrize("pre", ["finch", "dashing", "skani"])
+@pytest.mark.parametrize("pre", [
+    "finch",
+    # HLL default-tier coverage continues via test_hll.py and
+    # test_synthetic_families[dashing]; this e2e variant is the
+    # 30 s outlier of the file
+    pytest.param("dashing", marks=pytest.mark.slow),
+    "skani",
+])
 def test_degenerate_genomes_cluster_alone(tmp_path, pre):
     """All-N and shorter-than-k genomes survive every precluster backend
     end-to-end and land in singleton clusters (no reference analog —

@@ -199,6 +199,7 @@ def test_generic_batch_path_matches_c_path(tmp_path):
             np.testing.assert_array_equal(a.markers, b.markers)
 
 
+@pytest.mark.slow
 def test_directed_c_batch_path_parity(profiles, tmp_path):
     """>=64 uniform pairs trigger the batched C merge + vectorized
     post-math (_directed_ani_batch_c); every DirectedANI must be
@@ -219,6 +220,35 @@ def test_directed_c_batch_path_parity(profiles, tmp_path):
         assert got == fragment_ani.directed_ani(q, r)
 
 
+def test_bidirectional_values_parity_subsampled(ref_data, tmp_path):
+    """Default-tier twin of test_bidirectional_values_parity: the
+    same <64 and >=64 (batched C array) paths, on subsample_c=16
+    profiles so the per-pair walks cost ~16x less — the path
+    selection in bidirectional_ani_values depends on pair count and
+    concat volume, not the subsample, so coverage is equivalent. A
+    zero-window profile rides in the >=64 batch so the empty-query
+    edge of the C array path stays default-tier covered."""
+    profs = [fragment_ani.build_profile(
+        read_genome(str(ref_data / n)), k=15, fraglen=3000,
+        subsample_c=16) for n in ABISKO]
+    empty_fa = tmp_path / "tiny.fna"
+    empty_fa.write_bytes(b">c1\nACGTACGT\n")
+    tiny = fragment_ani.build_profile(
+        read_genome(str(empty_fa)), k=15, fraglen=3000,
+        subsample_c=16)
+    assert tiny.n_windows == 0
+    small = [(profs[i], profs[j])
+             for i in range(4) for j in range(i + 1, 4)]
+    big = (small * 12)[:68] + [(tiny, profs[0]), (profs[1], tiny)]
+    for pairs in (small, big):
+        want = [ani for ani, _, _ in fragment_ani.bidirectional_ani_batch(
+            pairs, min_aligned_frac=0.2)]
+        got = fragment_ani.bidirectional_ani_values(
+            pairs, min_aligned_frac=0.2)
+        assert got == want
+
+
+@pytest.mark.slow
 def test_bidirectional_values_parity(profiles):
     """bidirectional_ani_values == the ani column of
     bidirectional_ani_batch on both the per-pair (<64) and the
