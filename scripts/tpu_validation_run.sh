@@ -37,12 +37,12 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
 }
 
 run_stage test_tpu_hw 2400 env GALAH_RUN_SLOW=1 \
-  python -m pytest tests/test_tpu_hw.py -q
-run_stage amortized 1800 python scripts/bench_amortized.py
-run_stage bench 3000 python bench.py
-run_stage kernel_variants 1200 python scripts/bench_kernel_variants.py
-run_stage sketch_variants 1200 python scripts/bench_sketch_variants.py
-run_stage ladder_tpu 3600 python scripts/ladder_bench.py --n 1000 \
+  python -u -m pytest tests/test_tpu_hw.py -q
+run_stage amortized 1800 python -u scripts/bench_amortized.py
+run_stage bench 3000 python -u bench.py
+run_stage kernel_variants 1200 python -u scripts/bench_kernel_variants.py
+run_stage sketch_variants 1200 python -u scripts/bench_sketch_variants.py
+run_stage ladder_tpu 3600 python -u scripts/ladder_bench.py --n 1000 \
   --genome-len 100000 --skip-rung1 --hash tpufast --ani-subsample 16
 
 echo "=== done $(date -u) — captures in $ART ===" >> "$LOG"
