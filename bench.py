@@ -607,6 +607,19 @@ def main():
                 stages["e2e_fast_n_clusters"] = nc
         except Exception as e:  # noqa: BLE001
             errors.append(f"e2e-fallback: {type(e).__name__}: {e}")
+        # Pin the platform UNCONDITIONALLY before the ladder stages:
+        # if the watchdog fired above, the jax.config update may never
+        # have happened, and the ladder's first jax import would attach
+        # to the same wedged tunnel the probe timed out on. The env var
+        # covers both this process (if jax is not yet imported) and the
+        # config path (if it is).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"cpu-pin: {type(e).__name__}: {e}")
         run_ladder_stages(stages, errors)
         print(json.dumps(result))
         return

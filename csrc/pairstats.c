@@ -426,6 +426,19 @@ void galah_window_match_counts_merge(
     merge_count_resolve()(qh, qw, nq, ref, H, matched);
 }
 
+/* Capability probe for the test harness: 1 iff the merge counter
+ * would dispatch to the AVX-512 kernel right now (build support +
+ * CPU support + GALAH_TPU_NO_AVX512 unset). Lets the A/B identity
+ * test SKIP with an explicit reason instead of silently comparing
+ * scalar against scalar on hosts without avx512f. */
+int galah_merge_uses_avx512(void) {
+#ifdef GALAH_HAVE_AVX512_BUILD
+    return merge_count_resolve() != merge_count_scalar;
+#else
+    return 0;
+#endif
+}
+
 /* Batched sorted-merge membership counter: the per-PAIR-LIST twin of
  * galah_window_match_counts_merge, for the exact-ANI stage when the
  * pair volume is large (the dense-similarity regime can carry N^2/2

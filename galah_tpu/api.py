@@ -67,6 +67,7 @@ class ClustererCommandDefinition:
     min_completeness: str = "min-completeness"
     max_contamination: str = "max-contamination"
     threads: str = "threads"
+    on_bad_genome: str = "on-bad-genome"
 
     def dest(self, flag_name: str) -> str:
         return flag_name.replace("-", "_")
@@ -151,6 +152,16 @@ def add_cluster_arguments(
                              "and CPU-backend native sketching/"
                              "profiling; "
                              "device parallelism is managed by the mesh")
+    from galah_tpu.resilience.quarantine import ON_BAD_GENOME_CHOICES
+
+    parser.add_argument(f"--{d.on_bad_genome}",
+                        default="error", choices=ON_BAD_GENOME_CHOICES,
+                        help="What to do with unreadable genome FASTAs "
+                             "(missing, empty, corrupt): 'error' aborts "
+                             "on first touch (default); 'skip' "
+                             "preflights every input, quarantines the "
+                             "bad ones into quarantine.json next to "
+                             "the outputs, and clusters the rest")
 
 
 @dataclasses.dataclass
@@ -174,6 +185,10 @@ class GalahClusterer:
     #: speculative rep-scan batch width (None = engine default); the
     #: waste it buys is reported as the exact-ani-wasted counter
     rep_scan_window: Optional[int] = None
+    #: genomes quarantined by the --on-bad-genome=skip preflight (None
+    #: under the default error policy); the CLI writes this next to the
+    #: outputs as quarantine.json
+    quarantine: Optional[object] = None
 
     def cluster(self) -> List[List[int]]:
         from galah_tpu.cluster import cluster as run
@@ -193,6 +208,7 @@ def generate_galah_clusterer(
     values: Dict,
     definition: ClustererCommandDefinition = ClustererCommandDefinition(),
     cache=None,
+    quarantine_manifest=None,
 ) -> GalahClusterer:
     """Quality-filter + order genomes and construct the backends.
 
@@ -244,6 +260,31 @@ def generate_galah_clusterer(
         raise ValueError(
             f"--{d.rep_scan_window} must be >= 1, got {rep_scan_window}")
 
+    # Bad-input quarantine — BEFORE quality ordering, which already
+    # reads every genome for stats: under 'skip' the unreadable ones
+    # are removed here (identically on every host) so neither the
+    # quality pass nor the sketch stage ever touches them. The default
+    # 'error' policy costs zero extra IO: first touch still raises.
+    on_bad = (_get(values, d, d.on_bad_genome) or "error")
+    from galah_tpu.resilience.quarantine import (
+        ON_BAD_GENOME_CHOICES,
+        preflight_quarantine,
+    )
+
+    if on_bad not in ON_BAD_GENOME_CHOICES:
+        raise ValueError(
+            f"unknown --{d.on_bad_genome} policy {on_bad!r}; "
+            f"choices: {ON_BAD_GENOME_CHOICES}")
+    quarantine = quarantine_manifest
+    genome_paths = list(genome_paths)
+    if on_bad == "skip":
+        genome_paths, quarantine = preflight_quarantine(
+            genome_paths, manifest=quarantine_manifest)
+        if not genome_paths:
+            raise ValueError(
+                "every input genome was quarantined as unreadable; "
+                "nothing to cluster (see the quarantine manifest)")
+
     # Quality filter + ordering
     quality_inputs = [
         ("checkm_tab_table", _get(values, d, d.checkm_tab_table)),
@@ -256,7 +297,6 @@ def generate_galah_clusterer(
         raise ValueError(
             "Specify at most one of --checkm-tab-table, "
             "--checkm2-quality-report and --genome-info")
-    genome_paths = list(genome_paths)
     if not given:
         logger.warning(
             "Since CheckM input is missing, genomes are not being ordered "
@@ -338,4 +378,5 @@ def generate_galah_clusterer(
     }
     return GalahClusterer(genome_paths=genome_paths, preclusterer=pre,
                           clusterer=cl, backend_params=backend_params,
-                          rep_scan_window=rep_scan_window)
+                          rep_scan_window=rep_scan_window,
+                          quarantine=quarantine)

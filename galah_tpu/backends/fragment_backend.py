@@ -209,9 +209,29 @@ class _FragmentANIMixin:
                 by_path = dict(zip(unique, self.store.get_many(unique)))
             profs = [(by_path[a], by_path[b]) for a, b in pairs]
         with timing.stage("fragment-ani"):
-            return fragment_ani.bidirectional_ani_values(
-                profs, min_aligned_frac=self.min_aligned_fraction,
-                threads=self.store.threads)
+            return _guarded_ani_values(
+                profs, self.min_aligned_fraction, self.store.threads)
+
+
+def _guarded_ani_values(profs, min_aligned_frac: float,
+                        threads: int) -> List[Optional[float]]:
+    """Guarded batched bidirectional-ANI dispatch, shared by the
+    cluster backends and the skani preclusterer. The per-pair fallback
+    trades the coalesced batch for N tiny dispatches, so a persistently
+    failing batched kernel degrades throughput, not the run (stage
+    report: demoted[dispatch.fragment-ani])."""
+    from galah_tpu.resilience import dispatch as rdispatch
+
+    return rdispatch.run(
+        "dispatch.fragment-ani",
+        lambda: fragment_ani.bidirectional_ani_values(
+            profs, min_aligned_frac=min_aligned_frac, threads=threads),
+        fallback=lambda: [
+            fragment_ani.bidirectional_ani_values(
+                [pp], min_aligned_frac=min_aligned_frac,
+                threads=threads)[0]
+            for pp in profs],
+        validate=rdispatch.expect_ani_values(len(profs)))
 
 
 class FastANIEquivalentClusterer(ClusterBackend, _FragmentANIMixin):
@@ -349,10 +369,9 @@ class SkaniPreclusterer(PreclusterBackend):
                         [genome_paths[g] for g in missing])))
             prof.update(
                 (g, warm[g]) for g in endpoints if g in warm)
-            return fragment_ani.bidirectional_ani_values(
+            return _guarded_ani_values(
                 [(prof[i], prof[j]) for i, j in my_pairs],
-                min_aligned_frac=self.min_aligned_fraction,
-                threads=self.store.threads)
+                self.min_aligned_fraction, self.store.threads)
 
         return distributed.sharded_optional_floats(
             len(pairs), compute_mine, owner=lambda k: pairs[k][1])
@@ -396,10 +415,9 @@ class SkaniPreclusterer(PreclusterBackend):
                     if ani is not None and ani >= self.threshold:
                         cache.insert((i, j), float(ani))
         else:
-            anis = fragment_ani.bidirectional_ani_values(
+            anis = _guarded_ani_values(
                 [(profiles[i], profiles[j]) for i, j in pairs],
-                min_aligned_frac=self.min_aligned_fraction,
-                threads=self.store.threads)
+                self.min_aligned_fraction, self.store.threads)
             for (i, j), ani in zip(pairs, anis):
                 if ani is not None and ani >= self.threshold:
                     cache.insert((i, j), ani)

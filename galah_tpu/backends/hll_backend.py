@@ -62,13 +62,27 @@ class HLLPreclusterer(PreclusterBackend):
             entry = self.cache.load(path, "hll", params)
             return entry["regs"] if entry is not None else None
 
+        from galah_tpu.resilience import dispatch as rdispatch
+
+        def sketch_batch(buf):
+            # Guarded device dispatch: retry transients, demote to the
+            # per-genome path on persistent failure (stage report:
+            # demoted[dispatch.sketch-hll]).
+            return rdispatch.run(
+                "dispatch.sketch-hll",
+                lambda: hll.hll_sketch_genomes_batch(
+                    [g for _, g in buf], p=self.p, k=self.k,
+                    seed=self.seed, algo=self.algo),
+                fallback=lambda: [hll.hll_sketch_genome(
+                    g, p=self.p, k=self.k, seed=self.seed,
+                    algo=self.algo) for _p, g in buf],
+                validate=rdispatch.expect_len(len(buf)))
+
         by_path, miss_iter = probe_and_prefetch(
             paths, probe, read_genome, depth=max(2, self.threads))
         for path, row in process_stream(
                 miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
-                lambda buf: hll.hll_sketch_genomes_batch(
-                    [g for _, g in buf], p=self.p, k=self.k,
-                    seed=self.seed, algo=self.algo),
+                sketch_batch,
                 lambda _path, g: hll.hll_sketch_genome(
                     g, p=self.p, k=self.k, seed=self.seed,
                     algo=self.algo),

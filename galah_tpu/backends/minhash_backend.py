@@ -140,12 +140,26 @@ class MinHashPreclusterer(PreclusterBackend):
             process_stream,
         )
 
+        from galah_tpu.resilience import dispatch as rdispatch
+
+        def sketch_batch(buf):
+            # Guarded device dispatch: retries transient failures and,
+            # after repeated ones, demotes this site to the per-genome
+            # CPU sketch path for the rest of the run (stage report:
+            # demoted[dispatch.sketch-minhash]).
+            return rdispatch.run(
+                "dispatch.sketch-minhash",
+                lambda: self.store.sketch_batch_only(buf),
+                fallback=lambda: [self.store.sketch_only(g)
+                                  for _p, g in buf],
+                validate=rdispatch.expect_len(len(buf)))
+
         by_path, miss_iter = probe_and_prefetch(
             paths, self.store.get_cached, read_genome,
             depth=max(2, self.threads))
         for p, s in process_stream(
                 miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
-                self.store.sketch_batch_only,
+                sketch_batch,
                 lambda _path, g: self.store.sketch_only(g),
                 batched=hashing.device_transfer_bound(),
                 workers=self.threads):

@@ -220,11 +220,17 @@ def run_cluster(args) -> int:
     distributed.initialize()
 
     timing.reset()
+    from galah_tpu.resilience.quarantine import QuarantineManifest
+
+    on_bad_genome = getattr(args, "on_bad_genome", "error") or "error"
+    qmanifest = QuarantineManifest()
     genomes = parse_genome_inputs(
         genome_fasta_files=args.genome_fasta_files,
         genome_fasta_list=args.genome_fasta_list,
         genome_fasta_directory=args.genome_fasta_directory,
         genome_fasta_extension=args.genome_fasta_extension,
+        on_bad_genome=on_bad_genome,
+        manifest=qmanifest,
     )
 
     cache = diskcache.get_cache(getattr(args, "sketch_cache", None))
@@ -235,8 +241,9 @@ def run_cluster(args) -> int:
     # embeddable factory (api.py, reference analog:
     # generate_galah_clusterer, src/cluster_argument_parsing.rs:897-1158)
     try:
-        clusterer = generate_galah_clusterer(genomes, vars(args),
-                                             cache=cache)
+        clusterer = generate_galah_clusterer(
+            genomes, vars(args), cache=cache,
+            quarantine_manifest=qmanifest)
     except ValueError as e:
         # User error (conflicting quality inputs, dRep + --genome-info):
         # a logged message and exit 1, not a traceback — the reference's
@@ -321,6 +328,27 @@ def run_cluster(args) -> int:
         logger.info("Finished printing genome clusters")
     else:
         logger.info("Non-zero process: outputs written by process 0")
+
+    # Quarantined inputs (--on-bad-genome skip) land in a manifest next
+    # to the outputs. Every host computed the identical quarantine set
+    # (resilience/quarantine.py's OR-exchange); only the writer writes.
+    if clusterer.quarantine is not None and len(clusterer.quarantine):
+        from galah_tpu.resilience.quarantine import manifest_output_dir
+
+        if is_writer:
+            clusterer.quarantine.write(manifest_output_dir(
+                cluster_definition=args.output_cluster_definition,
+                representative_list=args.output_representative_list,
+                checkpoint_dir=getattr(args, "checkpoint_dir", None)))
+
+    # Any mid-run demotions (device dispatch -> CPU fallback) belong in
+    # the run summary: the run completed, but not on the fast path.
+    from galah_tpu.resilience import dispatch as rdispatch
+
+    for dem in rdispatch.demotions():
+        logger.warning("Dispatch site %s ran DEMOTED to its fallback "
+                       "after persistent failures (%s)",
+                       dem.site, dem.reason)
     timing.GLOBAL.report(logger)
     return 0
 

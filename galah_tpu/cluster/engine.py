@@ -165,21 +165,52 @@ def _backend_ani_batch(
     every host touching every endpoint. A failing host propagates its
     error to every peer instead of stranding them in the collective.
     Single-process: a plain call.
+
+    Both paths route the batched backend call through the dispatch
+    supervisor (resilience/dispatch.py): transient device failures are
+    retried with backoff, garbage-shaped or out-of-range results are
+    rejected, and a persistently failing batch dispatch demotes this
+    site to a per-pair fallback loop for the rest of the run — recorded
+    in the stage report as ``demoted[dispatch.ani]``.
     """
     from galah_tpu.parallel import distributed
 
     n_proc = distributed.process_count()
     if n_proc <= 1 or len(path_pairs) < n_proc:
-        return clusterer.calculate_ani_batch(path_pairs)
+        return _guarded_ani_batch(clusterer, path_pairs)
 
     import zlib
 
     owners = [zlib.crc32(b.encode()) for _a, b in path_pairs]
     return distributed.sharded_optional_floats(
         len(path_pairs),
-        lambda idxs: clusterer.calculate_ani_batch(
-            [path_pairs[k] for k in idxs]),
+        lambda idxs: _guarded_ani_batch(
+            clusterer, [path_pairs[k] for k in idxs]),
         owner=lambda k: owners[k])
+
+
+def _guarded_ani_batch(
+    clusterer: ClusterBackend,
+    path_pairs: List[Tuple[str, str]],
+) -> List[Optional[float]]:
+    """The retry/validate/demote wrapper around one batched ANI call.
+
+    The fallback computes each pair in its own single-pair batch — the
+    smallest dispatch the backend exposes, so one poisoned batch (or a
+    wedged batched kernel) degrades throughput instead of killing the
+    run. Fallback results still flow through the batch validator.
+    """
+    from galah_tpu.resilience import dispatch as rdispatch
+
+    def fallback() -> List[Optional[float]]:
+        return [clusterer.calculate_ani_batch([p])[0]
+                for p in path_pairs]
+
+    return rdispatch.run(
+        "dispatch.ani",
+        lambda: clusterer.calculate_ani_batch(path_pairs),
+        fallback=fallback,
+        validate=rdispatch.expect_ani_values(len(path_pairs)))
 
 
 def _batch_ani(

@@ -18,7 +18,18 @@ def parse_genome_inputs(
     genome_fasta_list: Optional[str] = None,
     genome_fasta_directory: Optional[str] = None,
     genome_fasta_extension: str = "fna",
+    on_bad_genome: str = "error",
+    manifest=None,
 ) -> List[str]:
+    """Resolve the genome input spec into a path list.
+
+    Under ``on_bad_genome="skip"`` a nonexistent path is recorded in
+    `manifest` (a resilience.quarantine.QuarantineManifest) and dropped
+    instead of raising — the stat() verdict is identical on every host
+    of a shared-filesystem multi-host run, so the surviving list is
+    too. Content-level validation (corrupt/empty FASTA) happens later
+    in the preflight; this stage only has existence to go on.
+    """
     out: List[str] = []
     if genome_fasta_files:
         out.extend(genome_fasta_files)
@@ -40,6 +51,17 @@ def parse_genome_inputs(
             "--genome-fasta-list or --genome-fasta-directory")
     missing = [p for p in out if not os.path.isfile(p)]
     if missing:
+        if on_bad_genome == "skip":
+            if manifest is not None:
+                for p in missing:
+                    manifest.add(p, "missing", "not a regular file")
+            dropped = set(missing)
+            out = [p for p in out if p not in dropped]
+            if not out:
+                raise FileNotFoundError(
+                    "every input genome path is missing; nothing to "
+                    "cluster")
+            return out
         raise FileNotFoundError(
             f"Genome FASTA file(s) not found: {missing[:5]}")
     return out

@@ -15,9 +15,25 @@ from __future__ import annotations
 
 import dataclasses
 import gzip
-from typing import List, Optional
+import zlib
+from typing import List, Optional, Tuple
 
 import numpy as np
+
+
+class BadGenomeError(ValueError):
+    """A genome file that is deterministically unreadable — empty,
+    truncated, or corrupt. Distinct from transient IO errors (which
+    read_genome retries with backoff) and from FileNotFoundError (the
+    caller's input-spec problem): under ``--on-bad-genome skip`` these
+    land in the quarantine manifest instead of killing the run."""
+
+    def __init__(self, path: str, reason: str, detail: str = "") -> None:
+        self.path = path
+        self.reason = reason  # "empty" | "corrupt"
+        super().__init__(
+            f"{reason} genome FASTA {path}"
+            + (f": {detail}" if detail else ""))
 
 # ASCII -> 2-bit code; 255 marks ambiguous/non-ACGT.
 _CODE_LUT = np.full(256, 255, dtype=np.uint8)
@@ -90,20 +106,71 @@ def _get_cingest():
     return _CINGEST
 
 
+_IO_POLICY = None
+
+
+def _io_policy():
+    """Lazy, cached GALAH_IO_RETRY policy (read_genome runs per genome;
+    re-parsing the env every call would be pure overhead)."""
+    global _IO_POLICY
+    if _IO_POLICY is None:
+        from galah_tpu.resilience.policy import RetryPolicy
+
+        _IO_POLICY = RetryPolicy.from_env("GALAH_IO_RETRY",
+                                          max_attempts=3, base_delay=0.1)
+    return _IO_POLICY
+
+
+def _io_retryable(exc: BaseException) -> bool:
+    """Transient-IO classifier for the read retry: flaky network-FS
+    OSErrors are worth a backoff; a missing path or corrupt payload
+    (BadGzipFile/EOFError surface deterministically per byte content)
+    is not."""
+    if isinstance(exc, (FileNotFoundError, IsADirectoryError,
+                        gzip.BadGzipFile, EOFError, zlib.error)):
+        return False
+    return isinstance(exc, (OSError, TimeoutError))
+
+
 def read_genome(path: str, with_codes: bool = True) -> Genome:
     """Parse a (possibly gzipped) FASTA into codes + offsets + stats.
 
     Stats semantics match the reference goldens (reference:
     src/genome_stats.rs:61-87): num_contigs counts records, ambiguous counts
     every base that is not ACGT/acgt, N50 from descending cumulative sum.
+
+    Transient IO errors (network FS flakes) are retried with backoff
+    (GALAH_IO_RETRY_* env knobs, docs/resilience.md); deterministically
+    unreadable content raises BadGenomeError, which the quarantine
+    layer (resilience/quarantine.py) can isolate instead of dying.
     """
-    cingest = _get_cingest()
-    if cingest is not None:
-        try:
-            return _read_genome_c(cingest, path, with_codes)
-        except Exception:
-            pass  # fall back to the numpy path on any C-side failure
-    return read_genome_numpy(path, with_codes)
+    from galah_tpu.resilience.policy import call_with_retry
+
+    def attempt() -> Genome:
+        cingest = _get_cingest()
+        if cingest is not None:
+            try:
+                return _read_genome_c(cingest, path, with_codes)
+            except Exception:
+                pass  # fall back to the numpy path on any C-side failure
+        return read_genome_numpy(path, with_codes)
+
+    try:
+        return call_with_retry(attempt, _io_policy(),
+                               site=f"io.read[{path}]",
+                               classify=_io_retryable)
+    except BadGenomeError:
+        raise
+    except (gzip.BadGzipFile, EOFError, zlib.error) as e:
+        raise BadGenomeError(path, "corrupt", str(e)) from e
+    except ValueError as e:
+        reason, detail = _classify_value_error(e)
+        raise BadGenomeError(path, reason, detail) from e
+
+
+def _classify_value_error(e: ValueError) -> Tuple[str, str]:
+    msg = str(e)
+    return ("empty" if "no FASTA records" in msg else "corrupt", msg)
 
 
 def read_genome_numpy(path: str, with_codes: bool = True) -> Genome:

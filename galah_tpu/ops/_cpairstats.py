@@ -266,6 +266,18 @@ _fn_wmm.argtypes = [
 ]
 
 
+_fn_avx = _lib.galah_merge_uses_avx512
+_fn_avx.restype = ctypes.c_int
+_fn_avx.argtypes = []
+
+
+def merge_uses_avx512() -> bool:
+    """True iff the merge counter would dispatch to the AVX-512 kernel
+    right now (build + CPU support, GALAH_TPU_NO_AVX512 unset).
+    Re-resolved per call, so env toggles within a process are seen."""
+    return bool(_fn_avx())
+
+
 def window_match_counts_merge(
         qh: np.ndarray, qw: np.ndarray, n_windows: int,
         ref_set: np.ndarray, validate: bool = True) -> np.ndarray:

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from galah_tpu.utils.jax_compat import shard_map
 
 from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.hashing import HASH_SENTINEL

@@ -87,6 +87,28 @@ def global_mesh(axis_name: str = "i") -> Mesh:
     return Mesh(np.array(jax.devices()), (axis_name,))
 
 
+def exchange(tag: str, value: "np.ndarray",
+             tiled: bool = False) -> "np.ndarray":
+    """One guarded cross-host allgather: every collective in this module
+    funnels through here so transient runtime errors (the wedged-tunnel
+    signatures round 5 hit) get the retry policy, and so the fault
+    injector can target collectives by site name ("collective.<tag>").
+
+    Retry here is safe ONLY because a failed collective fails on every
+    participant — all hosts observe the error and re-enter together.
+    There is deliberately no fallback: a collective that stays broken
+    after the retry budget must kill the run, not desync it.
+    """
+    from jax.experimental import multihost_utils
+
+    from galah_tpu.resilience import dispatch as rdispatch
+
+    return rdispatch.run(
+        f"collective.{tag}",
+        lambda: np.asarray(
+            multihost_utils.process_allgather(value, tiled=tiled)))
+
+
 def allgather_host_rows(n_unique: int, local_rows: "np.ndarray",
                         fill=0) -> "np.ndarray":
     """Exchange per-host strided-shard rows into the full row matrix.
@@ -98,14 +120,16 @@ def allgather_host_rows(n_unique: int, local_rows: "np.ndarray",
     mirror host_shard's `items[rank::count]`, so it lives next to it.
     """
     n_proc = process_count()
+    if n_proc == 1:
+        # Identity — and process_allgather's single-process return has
+        # no leading process axis, so the reassembly below would
+        # misindex it.
+        return np.asarray(local_rows)[:n_unique]
     per = -(-n_unique // n_proc)
     padded = np.full((per, *local_rows.shape[1:]), fill,
                      dtype=local_rows.dtype)
     padded[: local_rows.shape[0]] = local_rows
-    from jax.experimental import multihost_utils
-
-    gathered = np.asarray(
-        multihost_utils.process_allgather(padded, tiled=False))
+    gathered = exchange("host-rows", padded)
     out = np.empty((n_unique, *local_rows.shape[1:]),
                    dtype=local_rows.dtype)
     for p in range(n_proc):
@@ -129,8 +153,6 @@ def sharded_optional_floats(n_total: int, compute_mine,
     peers inside the collective. None rides as NaN (producers never
     emit NaN values).
     """
-    from jax.experimental import multihost_utils
-
     n_proc = process_count()
     if n_proc <= 1:
         return compute_mine(list(range(n_total)))
@@ -152,8 +174,8 @@ def sharded_optional_floats(n_total: int, compute_mine,
         err = e
     raise_if_any_host_failed(err)
 
-    sizes = np.asarray(multihost_utils.process_allgather(
-        np.array([len(mine)], dtype=np.int64), tiled=False))
+    sizes = exchange("shard-sizes",
+                     np.array([len(mine)], dtype=np.int64))
     per = max(int(sizes.max()), 1)
     local = np.full((per, 2), np.nan, dtype=np.float64)
     local[:, 0] = -1.0  # "no item here"
@@ -161,8 +183,7 @@ def sharded_optional_floats(n_total: int, compute_mine,
         local[r, 0] = float(k)
         if v is not None:
             local[r, 1] = v
-    gathered = np.asarray(multihost_utils.process_allgather(
-        local, tiled=False))
+    gathered = exchange("shard-values", local)
     out: "List[Optional[float]]" = [None] * n_total
     for p in range(n_proc):
         for row in gathered[p]:
@@ -182,11 +203,8 @@ def raise_if_any_host_failed(err: "Exception | None") -> None:
         if err is not None:
             raise err
         return
-    from jax.experimental import multihost_utils
-
     status = np.array([1 if err is not None else 0], dtype=np.int64)
-    statuses = np.asarray(multihost_utils.process_allgather(
-        status, tiled=False))
+    statuses = exchange("host-status", status)
     if err is not None:
         raise err
     if int(statuses.sum()):
@@ -205,10 +223,7 @@ def tokens_agree(token: bytes) -> bool:
         hashlib.sha256(token).digest(), dtype=np.uint8).copy()
     if process_count() == 1:
         return True
-    from jax.experimental import multihost_utils
-
-    gathered = np.asarray(
-        multihost_utils.process_allgather(digest, tiled=False))
+    gathered = exchange("resume-token", digest)
     return bool((gathered == gathered[0]).all())
 
 

@@ -25,7 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from galah_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -174,10 +174,11 @@ def _sharded_blocked_extract(
                 # pcast marks the constant zeros as device-varying so
                 # the cond branches type-check under shard_map's vma
                 # typing.
+                from galah_tpu.utils.jax_compat import pcast_varying
+
                 return tuple(
-                    jax.lax.pcast(
-                        jnp.zeros((row_tile, col_tile), dt),
-                        "i", to="varying")
+                    pcast_varying(
+                        jnp.zeros((row_tile, col_tile), dt), "i")
                     for dt in stripe_dtypes)
 
             return jax.lax.cond(gt >= t_first, compute, skip, None)

@@ -1,0 +1,170 @@
+"""Bad-input quarantine: isolate unreadable genomes instead of dying.
+
+Real MAG collections carry truncated downloads, empty files, and
+half-written FASTA — today one of them kills an hours-long run that
+cluster/checkpoint.py then has to replay. Under ``--on-bad-genome skip``
+the pipeline preflights every genome before the first sketch dispatch,
+moves the unreadable ones into a quarantine manifest written next to
+the outputs, and clusters the rest.
+
+Determinism contract: the surviving genome list is IDENTICAL on every
+host — each host validates only its strided shard (IO scales with
+hosts), then the bad-genome masks are OR-combined through one
+collective, so the post-quarantine list (and therefore the checkpoint
+fingerprint, cluster/checkpoint.py run_fingerprint) agrees everywhere.
+A run that quarantines a genome clusters the remaining genomes exactly
+as a run that never saw it (pinned by tests/test_quarantine.py).
+
+Transient IO errors are NOT quarantine-worthy: io/fasta.py retries
+those with backoff first; only a genome that stays unreadable after
+the retry budget lands here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_NAME = "quarantine.json"
+
+ON_BAD_GENOME_CHOICES = ("error", "skip")
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantineRecord:
+    path: str
+    reason: str      # "missing" | "empty" | "corrupt" | "io-error"
+    detail: str = ""
+    stage: str = "preflight"
+
+
+class QuarantineManifest:
+    """The run's quarantined genomes; serializes to quarantine.json."""
+
+    def __init__(self) -> None:
+        self._records: List[QuarantineRecord] = []
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def add(self, path: str, reason: str, detail: str = "",
+            stage: str = "preflight") -> None:
+        self._records.append(QuarantineRecord(
+            path=path, reason=reason, detail=detail, stage=stage))
+        logger.warning("Quarantined genome %s (%s%s)", path, reason,
+                       f": {detail}" if detail else "")
+
+    def records(self) -> List[QuarantineRecord]:
+        return list(self._records)
+
+    def paths(self) -> set:
+        return {r.path for r in self._records}
+
+    def write(self, directory: str) -> str:
+        """Write the manifest into `directory`; returns the file path."""
+        os.makedirs(directory or ".", exist_ok=True)
+        out = os.path.join(directory or ".", MANIFEST_NAME)
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "version": 1,
+                "quarantined": [dataclasses.asdict(r)
+                                for r in self._records],
+            }, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, out)
+        logger.warning("Wrote quarantine manifest (%d genomes) to %s",
+                       len(self._records), out)
+        return out
+
+    @classmethod
+    def load(cls, path: str) -> "QuarantineManifest":
+        with open(path) as f:
+            data = json.load(f)
+        m = cls()
+        for rec in data.get("quarantined", []):
+            m._records.append(QuarantineRecord(**rec))
+        return m
+
+
+def validate_genome(path: str) -> Optional[Tuple[str, str]]:
+    """None when `path` parses as a FASTA genome, else (reason, detail).
+
+    Runs the full ingestion path (stats only — no code array retained)
+    so whatever would crash the sketch stage crashes here instead,
+    with io/fasta.py's transient-IO retry already applied.
+    """
+    from galah_tpu.io.fasta import BadGenomeError, read_genome
+
+    try:
+        read_genome(path, with_codes=False)
+        return None
+    except FileNotFoundError as e:
+        return "missing", str(e)
+    except BadGenomeError as e:
+        return e.reason, str(e)
+    except OSError as e:  # persistent IO failure after retries
+        return "io-error", f"{type(e).__name__}: {e}"
+
+
+def preflight_quarantine(
+    genome_paths: Sequence[str],
+    manifest: Optional[QuarantineManifest] = None,
+    validate: Callable[
+        [str], Optional[Tuple[str, str]]] = validate_genome,
+) -> Tuple[List[str], QuarantineManifest]:
+    """Validate every genome; returns (kept paths, manifest).
+
+    Multi-host: each host validates its strided shard, the bad masks
+    are OR-exchanged, and every host removes the identical set —
+    quality ordering, sketching, and the checkpoint fingerprint all see
+    the same survivor list on every process.
+    """
+    import numpy as np
+
+    from galah_tpu.parallel import distributed
+    from galah_tpu.utils import timing
+
+    manifest = manifest if manifest is not None else QuarantineManifest()
+    unique = list(dict.fromkeys(genome_paths))
+    n = len(unique)
+    bad = np.zeros(n, dtype=np.uint8)
+    reasons: dict = {}
+    with timing.stage("preflight-genomes"):
+        for i in distributed.host_shard(list(range(n))):
+            verdict = validate(unique[i])
+            if verdict is not None:
+                bad[i] = 1
+                reasons[i] = verdict
+        if distributed.process_count() > 1:
+            gathered = distributed.exchange("quarantine-mask", bad)
+            bad = gathered.max(axis=0).astype(np.uint8)
+    for i in np.nonzero(bad)[0].tolist():
+        reason, detail = reasons.get(
+            i, ("corrupt", "flagged by a peer host"))
+        manifest.add(unique[i], reason, detail)
+    timing.counter("quarantined-genomes", int(bad.sum()))
+    dropped = {unique[i] for i in np.nonzero(bad)[0].tolist()}
+    kept = [p for p in genome_paths if p not in dropped]
+    return kept, manifest
+
+
+def manifest_output_dir(
+    cluster_definition: Optional[str] = None,
+    representative_list: Optional[str] = None,
+    checkpoint_dir: Optional[str] = None,
+) -> str:
+    """Where 'next to the outputs' is: the cluster-definition file's
+    directory, else the representative list's, else the checkpoint
+    dir, else the working directory."""
+    for anchor in (cluster_definition, representative_list):
+        if anchor:
+            return os.path.dirname(os.path.abspath(anchor))
+    if checkpoint_dir:
+        return checkpoint_dir
+    return "."

@@ -1,0 +1,41 @@
+"""Version-drift shims for the JAX surface this framework leans on.
+
+The deployment images pin different jax releases than dev boxes, and the
+shard_map entry point has moved twice (jax.experimental.shard_map ->
+jax.shard_map) with a keyword rename (check_rep -> check_vma) along the
+way. Kernel modules import `shard_map` from here so a version bump never
+takes the whole sharded pairwise path (and its test tier) down with an
+ImportError at module import time.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pre-0.6 releases: experimental entry point, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    @functools.wraps(_shard_map_exp)
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_exp(f, *args, **kwargs)
+
+
+def pcast_varying(x, axis_name: str):
+    """jax.lax.pcast(x, axis, to="varying") where it exists.
+
+    Releases without pcast predate the vma type system entirely, so
+    constants inside shard_map bodies need no varying marker there —
+    the identity is the correct no-op.
+    """
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    return x
+
+
+__all__ = ["shard_map", "pcast_varying"]

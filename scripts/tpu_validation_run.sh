@@ -36,10 +36,17 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
   cat "$ART/$name.txt" >> "$LOG"
 }
 
+# One variable governs both the harness kill and bench.py's internal
+# per-stage cap. The internal cap runs 120 s shorter so bench.py can
+# skip remaining stages and still print its JSON result line before
+# the external `timeout` would SIGKILL it mid-write (the round-5
+# captures that exited 124 with no data died exactly that way).
+BENCH_TIMEOUT=3000
 run_stage test_tpu_hw 2400 env GALAH_RUN_SLOW=1 \
   python -u -m pytest tests/test_tpu_hw.py -q
 run_stage amortized 1800 python -u scripts/bench_amortized.py
-run_stage bench 3000 python -u bench.py
+run_stage bench "$BENCH_TIMEOUT" env \
+  GALAH_BENCH_STAGE_CAP=$((BENCH_TIMEOUT - 120)) python -u bench.py
 run_stage kernel_variants 1200 python -u scripts/bench_kernel_variants.py
 run_stage sketch_variants 1200 python -u scripts/bench_sketch_variants.py
 run_stage ladder_tpu 3600 python -u scripts/ladder_bench.py --n 1000 \

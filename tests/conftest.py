@@ -33,6 +33,12 @@ def pytest_configure(config):
         "slow: multi-minute campaign/scale tests — skipped by default "
         "so the inner dev loop stays under ~5 min; run them with "
         "GALAH_RUN_SLOW=1 (or GALAH_RUN_CAMPAIGN=1, or -m slow)")
+    config.addinivalue_line(
+        "markers",
+        "fault_injection: seeded fault-injection tests of the "
+        "resilience layer (retry/demote/quarantine) — fast, CPU-only, "
+        "part of the default tier-1 run; select just them with "
+        "-m fault_injection")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -133,3 +133,46 @@ def test_dense_precluster_single_dispatch_same_result():
     eng_cluster(GENOMES, FakePre(), cl_counted, dense_precluster_cap=64)
     n_preclusters_with_pairs = 3  # decades 0,1,4 have >=2 members
     assert len(calls) == n_preclusters_with_pairs
+
+
+def test_torn_record_dropped_on_resume(tmp_path, caplog):
+    """A kill mid-append leaves a half-written last line in
+    clusters.jsonl; load_completed drops exactly that record (with a
+    warning) and keeps the intact ones."""
+    import logging
+
+    fp = run_fingerprint(GENOMES, "fake", "fakecl", 0.95, 0.9)
+    ck1 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    cluster(GENOMES, FakePre(), FakeCl(0.95), checkpoint=ck1)
+
+    fn = tmp_path / "ck" / "clusters.jsonl"
+    lines = fn.read_text().splitlines(keepends=True)
+    assert len(lines) >= 2
+    fn.write_text("".join(lines[:-1])
+                  + lines[-1][: len(lines[-1]) // 2].rstrip("\n"))
+
+    ck2 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    with caplog.at_level(logging.WARNING):
+        done = ck2.load_completed()
+    assert len(done) == len(lines) - 1
+    assert "torn checkpoint record" in caplog.text
+
+
+def test_torn_record_resume_identical_clusters(tmp_path):
+    """Resuming over a torn tail recomputes only that precluster and
+    produces clusters identical to the uninterrupted run."""
+    fp = run_fingerprint(GENOMES, "fake", "fakecl", 0.95, 0.9)
+    ck1 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    ref = cluster(GENOMES, FakePre(), FakeCl(0.95), checkpoint=ck1)
+
+    fn = tmp_path / "ck" / "clusters.jsonl"
+    lines = fn.read_text().splitlines(keepends=True)
+    fn.write_text("".join(lines[:-1])
+                  + lines[-1][: len(lines[-1]) // 2].rstrip("\n"))
+
+    pre = FakePre()
+    cl = FakeCl(0.95)
+    ck2 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    out = cluster(GENOMES, pre, cl, checkpoint=ck2)
+    assert out == ref
+    assert pre.calls == 0  # distance pass still resumed from disk

@@ -101,6 +101,78 @@ def test_process_stream_workers_propagates_errors():
         raise AssertionError("expected RuntimeError")
 
 
+def test_abandoned_generator_settles_inflight_loads():
+    """Closing a partially-consumed stream waits out running loads and
+    cancels queued ones (_settle) — after close() returns, no load_fn
+    is racing with the caller's cleanup (e.g. a temp-dir removal after
+    the exception that abandoned the stream)."""
+    lock = threading.Lock()
+    running = set()
+    started = []
+
+    def load(p):
+        with lock:
+            running.add(p)
+            started.append(p)
+        time.sleep(0.02)
+        with lock:
+            running.discard(p)
+        return p
+
+    gen = iter_prefetched([f"p{i}" for i in range(10)], load, depth=3)
+    assert next(gen) == ("p0", "p0")
+    gen.close()
+    with lock:
+        assert not running  # nothing still executing
+    n = len(started)
+    time.sleep(0.05)
+    assert len(started) == n  # nothing new started after close
+
+
+def test_settle_swallows_worker_errors_on_abandon():
+    """A load that fails while the generator is being abandoned is
+    absorbed by _settle (there is no consumer left to surface it to) —
+    close() must not raise."""
+    def load(p):
+        if p != "p0":
+            time.sleep(0.005)
+            raise ValueError(p)
+        return p
+
+    gen = iter_prefetched([f"p{i}" for i in range(6)], load, depth=2)
+    assert next(gen) == ("p0", "p0")
+    gen.close()  # in-flight failures absorbed, not raised
+
+
+def test_process_stream_abandoned_settles_workers():
+    """Same contract for the worker-pool branch of process_stream: an
+    abandoned stream leaves no single_fn running or newly starting."""
+    from galah_tpu.io.prefetch import process_stream
+
+    lock = threading.Lock()
+    state = {"running": 0, "started": 0}
+
+    def work(p, v):
+        with lock:
+            state["running"] += 1
+            state["started"] += 1
+        time.sleep(0.02)
+        with lock:
+            state["running"] -= 1
+        return v
+
+    items = [(f"p{i}", i) for i in range(12)]
+    gen = process_stream(iter(items), lambda v: 1, 10**9, None, work,
+                         batched=False, workers=3)
+    assert next(gen) == ("p0", 0)
+    gen.close()
+    with lock:
+        assert state["running"] == 0
+    n = state["started"]
+    time.sleep(0.05)
+    assert state["started"] == n
+
+
 def test_live_stream_survives_pool_growth():
     """A partially-consumed stream holds the shared pool it started
     on; a later, larger request must not shut that pool down under it

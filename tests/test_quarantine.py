@@ -1,0 +1,226 @@
+"""Bad-input quarantine (galah_tpu/resilience/quarantine.py).
+
+Pins the --on-bad-genome contract: under "skip" unreadable genomes land
+in a quarantine manifest and the surviving genomes cluster exactly as a
+run that never saw the bad ones; under "error" (the default) nothing
+changed from before the feature existed.
+"""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from galah_tpu.genome_inputs import parse_genome_inputs
+from galah_tpu.resilience.quarantine import (
+    MANIFEST_NAME,
+    QuarantineManifest,
+    manifest_output_dir,
+    preflight_quarantine,
+    validate_genome,
+)
+
+pytestmark = pytest.mark.fault_injection
+
+
+def write_genome(path, seed, length=30_000, mutate_from=None,
+                 rate=0.02):
+    rng = np.random.default_rng(seed)
+    if mutate_from is None:
+        seq = rng.integers(0, 4, size=length)
+    else:
+        seq = np.array(mutate_from, copy=True)
+        sites = rng.random(seq.shape[0]) < rate
+        seq[sites] = (seq[sites]
+                      + rng.integers(1, 4, size=int(sites.sum()))) % 4
+    path.write_text(">c\n" + "".join("ACGT"[c] for c in seq) + "\n")
+    return seq
+
+
+@pytest.fixture
+def bad_files(tmp_path):
+    """One of each quarantine-worthy pathology."""
+    bad = tmp_path / "bad.fna"
+    bad.write_text("this is not FASTA at all\n")
+    empty = tmp_path / "empty.fna"
+    empty.write_text("")
+    trunc = tmp_path / "trunc.fna.gz"
+    whole = gzip.compress(b">c\n" + b"ACGT" * 2000 + b"\n")
+    trunc.write_bytes(whole[: len(whole) // 2])
+    missing = tmp_path / "missing.fna"
+    return {"bad": str(bad), "empty": str(empty),
+            "trunc": str(trunc), "missing": str(missing)}
+
+
+# -- validate_genome ------------------------------------------------
+
+
+def test_validate_genome_verdicts(tmp_path, bad_files):
+    good = tmp_path / "good.fna"
+    write_genome(good, seed=1, length=5000)
+    assert validate_genome(str(good)) is None
+
+    assert validate_genome(bad_files["missing"])[0] == "missing"
+    assert validate_genome(bad_files["empty"])[0] == "empty"
+    assert validate_genome(bad_files["trunc"])[0] == "corrupt"
+    reason, _detail = validate_genome(bad_files["bad"])
+    assert reason in ("corrupt", "empty")
+
+
+def test_missing_file_not_retried(tmp_path, monkeypatch):
+    """FileNotFoundError is deterministic — the IO retry loop must not
+    burn its backoff budget on it."""
+    import time as time_mod
+
+    slept = []
+    monkeypatch.setattr(time_mod, "sleep",
+                        lambda d: slept.append(d))
+    verdict = validate_genome(str(tmp_path / "nope.fna"))
+    assert verdict[0] == "missing"
+    assert slept == []
+
+
+# -- manifest -------------------------------------------------------
+
+
+def test_manifest_write_load_roundtrip(tmp_path):
+    m = QuarantineManifest()
+    m.add("/data/a.fna", "corrupt", "bad gzip stream")
+    m.add("/data/b.fna", "missing")
+    out = m.write(str(tmp_path))
+    assert os.path.basename(out) == MANIFEST_NAME
+
+    with open(out) as f:
+        data = json.load(f)
+    assert data["version"] == 1
+    assert [r["path"] for r in data["quarantined"]] == [
+        "/data/a.fna", "/data/b.fna"]
+
+    back = QuarantineManifest.load(out)
+    assert back.records() == m.records()
+    assert back.paths() == {"/data/a.fna", "/data/b.fna"}
+
+
+def test_manifest_output_dir_anchors(tmp_path):
+    cd = str(tmp_path / "out" / "clusters.tsv")
+    rl = str(tmp_path / "reps" / "reps.txt")
+    assert manifest_output_dir(cluster_definition=cd) == str(
+        tmp_path / "out")
+    assert manifest_output_dir(representative_list=rl) == str(
+        tmp_path / "reps")
+    assert manifest_output_dir(checkpoint_dir="/ck") == "/ck"
+    assert manifest_output_dir() == "."
+
+
+# -- preflight ------------------------------------------------------
+
+
+def test_preflight_keeps_good_quarantines_bad(tmp_path, bad_files):
+    good1 = tmp_path / "g1.fna"
+    good2 = tmp_path / "g2.fna"
+    write_genome(good1, seed=1, length=5000)
+    write_genome(good2, seed=2, length=5000)
+    paths = [str(good1), bad_files["bad"], str(good2),
+             bad_files["missing"], bad_files["trunc"]]
+
+    kept, manifest = preflight_quarantine(paths)
+    assert kept == [str(good1), str(good2)]
+    assert manifest.paths() == {bad_files["bad"], bad_files["missing"],
+                                bad_files["trunc"]}
+    reasons = {r.path: r.reason for r in manifest.records()}
+    assert reasons[bad_files["missing"]] == "missing"
+    assert reasons[bad_files["trunc"]] == "corrupt"
+
+
+def test_preflight_all_good_is_identity(tmp_path):
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"g{i}.fna"
+        write_genome(p, seed=i, length=4000)
+        paths.append(str(p))
+    kept, manifest = preflight_quarantine(paths)
+    assert kept == paths
+    assert len(manifest) == 0
+
+
+# -- genome input parsing under the skip policy ---------------------
+
+
+def test_parse_inputs_error_policy_unchanged(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        parse_genome_inputs(
+            genome_fasta_files=[str(tmp_path / "nope.fna")])
+
+
+def test_parse_inputs_skip_drops_missing_into_manifest(tmp_path):
+    good = tmp_path / "g.fna"
+    write_genome(good, seed=3, length=4000)
+    m = QuarantineManifest()
+    out = parse_genome_inputs(
+        genome_fasta_files=[str(good), str(tmp_path / "nope.fna")],
+        on_bad_genome="skip", manifest=m)
+    assert out == [str(good)]
+    assert m.paths() == {str(tmp_path / "nope.fna")}
+    assert m.records()[0].reason == "missing"
+
+
+def test_parse_inputs_skip_all_missing_still_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        parse_genome_inputs(
+            genome_fasta_files=[str(tmp_path / "a.fna"),
+                                str(tmp_path / "b.fna")],
+            on_bad_genome="skip", manifest=QuarantineManifest())
+
+
+# -- acceptance (c): quarantined run == run that never saw the file --
+
+
+VALUES = {"ani": 95.0, "precluster_ani": 90.0,
+          "min_aligned_fraction": 15.0, "fragment_length": 3000,
+          "precluster_method": "finch", "cluster_method": "skani",
+          "threads": 1}
+
+
+def _cluster_paths(paths, **extra):
+    """Cluster and return path-level clusters (index-free compare)."""
+    from galah_tpu.api import generate_galah_clusterer
+
+    cl = generate_galah_clusterer(paths, {**VALUES, **extra})
+    return (sorted(sorted(cl.genome_paths[i] for i in c)
+                   for c in cl.cluster()),
+            cl)
+
+
+def test_skip_policy_clusters_match_clean_run(tmp_path, bad_files):
+    """Corrupt FASTA under --on-bad-genome skip is quarantined and the
+    surviving genomes cluster bit-identically to a run that never
+    included it (the tentpole's acceptance criterion c)."""
+    base = write_genome(tmp_path / "a.fna", seed=11)
+    write_genome(tmp_path / "b.fna", seed=12, mutate_from=base)
+    write_genome(tmp_path / "far.fna", seed=13)
+    good = [str(tmp_path / "a.fna"), str(tmp_path / "b.fna"),
+            str(tmp_path / "far.fna")]
+
+    clean, _cl = _cluster_paths(good)
+    dirty_paths = good[:2] + [bad_files["trunc"]] + good[2:]
+    dirty, cl = _cluster_paths(dirty_paths, on_bad_genome="skip")
+
+    assert dirty == clean
+    assert cl.quarantine is not None
+    assert cl.quarantine.paths() == {bad_files["trunc"]}
+    assert bad_files["trunc"] not in cl.genome_paths
+
+
+def test_error_policy_raises_on_corrupt(tmp_path, bad_files):
+    write_genome(tmp_path / "a.fna", seed=11)
+    paths = [str(tmp_path / "a.fna"), bad_files["bad"]]
+    with pytest.raises(Exception):
+        _cluster_paths(paths)[0]
+
+
+def test_all_quarantined_raises(tmp_path, bad_files):
+    with pytest.raises(ValueError, match="quarantin"):
+        _cluster_paths([bad_files["bad"], bad_files["empty"]],
+                       on_bad_genome="skip")
