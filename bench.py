@@ -456,6 +456,41 @@ def _admitted_watchdog(cost_s, label, errors):
     return watchdog(cost_s)
 
 
+def _run_pairlist_variants_stage(stages, errors, interpret=False):
+    """Per-strategy pairlist throughput + per-term cost breakdown in a
+    subprocess (scripts/bench_pairlist_variants.py). The script is
+    self-budgeting under the cost we pass, and the subprocess timeout
+    adds slack for interpreter startup — a wedge mid-variant cannot
+    take down the bench line. `interpret` records the CPU structural
+    run so even a no-tunnel capture documents the strategy matrix."""
+    _PAIRLIST_COST = 120 if interpret else 300   # hard <=5 min cap
+    if not _admit(_PAIRLIST_COST, "pairlist_variants", errors):
+        return
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        cmd = [sys.executable,
+               os.path.join(here, "scripts",
+                            "bench_pairlist_variants.py"),
+               "--budget", str(_PAIRLIST_COST - 30)]
+        if interpret:
+            cmd.append("--interpret")
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True,
+            timeout=_PAIRLIST_COST, cwd=here)
+        data = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("PAIRLIST_JSON "):
+                data = json.loads(line[len("PAIRLIST_JSON "):])
+        if data is None:
+            raise RuntimeError(
+                f"rc={proc.returncode}: {proc.stderr[-400:]}")
+        if interpret:
+            data["interpret"] = True
+        stages["pairlist_variants"] = data
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"pairlist_variants: {type(e).__name__}: {e}")
+
+
 def run_ladder_stages(stages, errors):
     """North-star-relevant e2e evidence in the driver artifact itself.
 
@@ -621,6 +656,9 @@ def main():
         except Exception as e:  # noqa: BLE001
             errors.append(f"cpu-pin: {type(e).__name__}: {e}")
         run_ladder_stages(stages, errors)
+        # Strategy matrix still recorded (interpret mode) so a
+        # no-tunnel capture is a documented negative, not a silence.
+        _run_pairlist_variants_stage(stages, errors, interpret=True)
         print(json.dumps(result))
         return
 
@@ -702,6 +740,13 @@ def main():
             stages["amortized_on_chip"] = amort
         except Exception as e:  # noqa: BLE001
             errors.append(f"amortized: {type(e).__name__}: {e}")
+
+    # 4d. Pairlist strategy matrix: every survivor-evaluation strategy
+    # (blocked P sweep, gather-dense, XLA) plus the per-term cost
+    # breakdown (grid overhead, DMA floor, u64-emulation tax) that
+    # turns a missed >=25%-of-ceiling target into a documented
+    # negative. Self-budgeting inside the subprocess; hard 5 min cap.
+    _run_pairlist_variants_stage(stages, errors)
 
     # 5. Sketching throughput on real FASTA bytes, both hash algos —
     # each with its own watchdog so one failure never loses the other.

@@ -41,6 +41,12 @@ class PreclusterBackend(abc.ABC):
 class ClusterBackend(abc.ABC):
     """Exact-ANI backend driving the greedy clustering decisions."""
 
+    # Batch-size hint for callers assembling speculative pair batches
+    # (cluster/engine.py): the backend's device evaluation processes
+    # pairs in blocks of this size, so batches that are a multiple of
+    # it run with no padded block slots. 1 = no blocking (host paths).
+    pair_block_multiple: int = 1
+
     @abc.abstractmethod
     def method_name(self) -> str: ...
 

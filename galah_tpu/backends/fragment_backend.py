@@ -234,6 +234,16 @@ def _guarded_ani_values(profs, min_aligned_frac: float,
         validate=rdispatch.expect_ani_values(len(profs)))
 
 
+def _device_pair_block() -> int:
+    """Backend batch-size hint (ClusterBackend.pair_block_multiple):
+    on a TPU backend the device evaluates pairs in P-pair blocks
+    (ops/pallas_pairlist.py), so the engine's speculative batches are
+    sized to fill them; host backends report 1 (no blocking)."""
+    from galah_tpu.ops.sparse_device import pair_block_quantum
+
+    return pair_block_quantum()
+
+
 class FastANIEquivalentClusterer(ClusterBackend, _FragmentANIMixin):
     def __init__(self, threshold: float, min_aligned_fraction: float,
                  fraglen: int = Defaults.FRAGMENT_LENGTH,
@@ -248,6 +258,10 @@ class FastANIEquivalentClusterer(ClusterBackend, _FragmentANIMixin):
 
     def method_name(self) -> str:
         return "fastani"
+
+    @property
+    def pair_block_multiple(self) -> int:
+        return _device_pair_block()
 
     @property
     def ani_threshold(self) -> float:
@@ -268,6 +282,10 @@ class SkaniEquivalentClusterer(ClusterBackend, _FragmentANIMixin):
 
     def method_name(self) -> str:
         return "skani"
+
+    @property
+    def pair_block_multiple(self) -> int:
+        return _device_pair_block()
 
     @property
     def ani_threshold(self) -> float:

@@ -312,13 +312,32 @@ def _find_representatives(
             f"rep_scan_window must be >= 1, got {window_size}")
     computed: List[Tuple[int, int]] = []   # pairs that hit the backend
     consulted: Set[Tuple[int, int]] = set()  # pairs a decision read
+    # Device-blocked backends (TPU pairlist kernel) evaluate pairs in
+    # blocks of this size; the windowed speculative batches below top
+    # up to a multiple of it with next-window pairs so the final block
+    # of a dispatch runs full instead of padded. Pure cache fill —
+    # decisions are identical, and the topped-up pairs are ones the
+    # next window's batch would have computed anyway.
+    quantum = max(1, int(getattr(clusterer, "pair_block_multiple", 1)))
 
-    def ensure_anis(pairs: List[Tuple[int, int]]) -> None:
+    def ensure_anis(pairs: List[Tuple[int, int]],
+                    lookahead=()) -> None:
         """Compute (rep, genome) ANIs not already in ani_cache."""
         missing = [(j, g) for j, g in pairs
                    if not ani_cache.contains((j, g))]
         if not missing:
             return
+        if quantum > 1 and len(missing) % quantum:
+            want = quantum - len(missing) % quantum
+            have = set(missing)
+            for p in lookahead:
+                if want == 0:
+                    break
+                if p in have or ani_cache.contains(p):
+                    continue
+                missing.append(p)
+                have.add(p)
+                want -= 1
         anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes,
                           missing, warm_cache, computed_log=computed)
         for (j, g), ani in zip(missing, anis):
@@ -329,8 +348,11 @@ def _find_representatives(
         # speculative batch: every window genome vs every CURRENT rep
         # (order is irrelevant here — ensure_anis just fills the cache)
         rep_list = list(reps)
+        nxt = range(window.stop, min(window.stop + window_size, n))
         ensure_anis([(j, g) for g in window for j in rep_list
-                     if pre_cache.contains((g, j))])
+                     if pre_cache.contains((g, j))],
+                    lookahead=((j, g) for g in nxt for j in rep_list
+                               if pre_cache.contains((g, j))))
         for i in window:
             cands = [(j, pre_cache.get((i, j))) for j in sorted(reps)
                      if pre_cache.contains((i, j))]

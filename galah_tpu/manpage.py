@@ -85,6 +85,17 @@ _SECTIONS: List[Tuple[str, str, List[str]]] = [
 
 _EPILOGS = {
     "cluster": """\
+REPEAT-DRIVEN MERGES
+   The exact-ANI gate passes a pair when EITHER direction's
+   matched-fragment fraction reaches --min-aligned-fraction, and the
+   reported ANI is the max over directions (reference fastANI-wrapper
+   semantics). Genomes that merely share repeats or mobile elements
+   can clear a low threshold on a sliver of their length: matched
+   windows sit near 100% identity, so the pair reports high ANI over
+   a low-but-passing aligned fraction. A runtime warning flags the
+   signature (marginal AND direction-asymmetric aligned fractions);
+   raising --min-aligned-fraction is the documented defense.
+
 EXIT STATUS
    0 on success, 1 on recoverable user error (bad flags, missing
    files); unexpected internal errors raise a traceback.

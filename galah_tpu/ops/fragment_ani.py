@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from typing import Optional, Tuple
 
 import jax
@@ -990,8 +991,48 @@ def bidirectional_ani_values(
     has = (fm[ab] > 0) | (fm[ba] > 0)
     keep = gate & has
     val = np.maximum(ani[ab], ani[ba])
+    af_ab = fm[ab] / np.maximum(ft[ab], 1)
+    af_ba = fm[ba] / np.maximum(ft[ba], 1)
+    hazard = keep & _repeat_hazard_mask(af_ab, af_ba, min_aligned_frac)
+    if hazard.any():
+        i = int(np.flatnonzero(hazard)[0])
+        _warn_repeat_merge_hazard(
+            int(hazard.sum()), float(max(af_ab[i], af_ba[i])),
+            float(min(af_ab[i], af_ba[i])), min_aligned_frac)
     return [float(v) if k_ else None
             for v, k_ in zip(val.tolist(), keep.tolist())]
+
+
+# Repeat-merge hazard signature (tests/test_repeat_regime.py): the
+# gate passes on an aligned fraction that is both MARGINAL (below
+# margin x threshold) and ASYMMETRIC (the other direction far lower).
+# Genome-wide relatedness aligns a similar fraction in both directions;
+# shared repeats/mobile elements align a sliver of each genome and the
+# slivers differ with genome size — exactly this shape.
+_HAZARD_AF_MARGIN = 2.0
+_HAZARD_ASYMMETRY = 3.0
+
+
+def _repeat_hazard_mask(af_ab, af_ba, min_aligned_frac: float):
+    """Vectorized hazard test on aligned-fraction pairs that already
+    passed the gate: marginal pass + strong directional asymmetry."""
+    hi = np.maximum(af_ab, af_ba)
+    lo = np.minimum(af_ab, af_ba)
+    return ((hi < _HAZARD_AF_MARGIN * min_aligned_frac)
+            & (hi >= _HAZARD_ASYMMETRY * lo))
+
+
+def _warn_repeat_merge_hazard(count: int, af_hi: float, af_lo: float,
+                              min_aligned_frac: float) -> None:
+    warnings.warn(
+        f"{count} pair(s) passed the aligned-fraction gate marginally "
+        f"and asymmetrically (e.g. {af_hi:.3f} vs {af_lo:.3f} against "
+        f"threshold {min_aligned_frac:.3f}) — the signature of shared "
+        "repeats/mobile elements rather than genome-wide identity; "
+        "the reported ANI is the max over directions and may merge "
+        "unrelated genomes. Consider raising --min-aligned-fraction "
+        "(see the manpage's 'Repeat-driven merges' note).",
+        RuntimeWarning, stacklevel=3)
 
 
 def _combine_bidirectional(
@@ -1000,14 +1041,15 @@ def _combine_bidirectional(
     """The reference's fastANI-wrapper gate (reference:
     src/fastani.rs:56-65): pass iff EITHER direction's matched-fragment
     fraction >= min_aligned_frac; result is the max ANI."""
-    gate = (
-        (ab.frags_total > 0
-         and ab.frags_matching / max(ab.frags_total, 1) >= min_aligned_frac)
-        or (ba.frags_total > 0
-            and ba.frags_matching / max(ba.frags_total, 1)
-            >= min_aligned_frac))
+    af_ab = ab.frags_matching / max(ab.frags_total, 1)
+    af_ba = ba.frags_matching / max(ba.frags_total, 1)
+    gate = ((ab.frags_total > 0 and af_ab >= min_aligned_frac)
+            or (ba.frags_total > 0 and af_ba >= min_aligned_frac))
     if not gate or (ab.frags_matching == 0 and ba.frags_matching == 0):
         return None
+    if bool(_repeat_hazard_mask(af_ab, af_ba, min_aligned_frac)):
+        _warn_repeat_merge_hazard(1, max(af_ab, af_ba),
+                                  min(af_ab, af_ba), min_aligned_frac)
     return max(ab.ani, ba.ani)
 
 

@@ -303,10 +303,14 @@ def tile_stats_pallas(
     ops/pairwise.tile_intersect_counts) and `total` the row's valid
     count.
 
-    range_skip stays False by default — DECIDED from hardware: the
-    2026-08-01 amortized on-chip campaign measured the skip variant
-    3.7x SLOWER on the dense tile (218.1k -> 59.4k pairs/s at
-    512x512; docs/artifacts/tpu_watch_20260801_0829/amortized.txt)."""
+    range_skip is QUARANTINED (hardware-retired): the 2026-08-01
+    amortized on-chip campaign measured the skip variant 3.7x SLOWER
+    on the dense tile (218.1k -> 59.4k pairs/s at 512x512;
+    docs/artifacts/tpu_watch_20260801_0829/amortized.txt) — the
+    data-dependent window bounds defeat Mosaic's static scheduling.
+    No default path sets it; its parity tests run only in the
+    slow/hardware tier. Kept as the reference windowed-compare
+    formulation."""
     br_in, k_in = rows.shape
     bc_in = cols.shape[0]
     sent = ~jnp.uint64(0)

@@ -20,10 +20,17 @@ pass, no u64 intermediates in HBM. Bit-identical to
 ops/hashing._murmur3_k21_1d (tests/test_pallas_sketch.py, interpret
 mode on CPU; tests/test_tpu_hw.py on hardware).
 
-Selection: opt-in via hash_algo="murmur3" + GALAH_TPU_PALLAS_HASH=1
-or the explicit entry point; scripts/bench_sketch_variants.py captures
-kernel-vs-XLA throughput whenever a chip is reachable. The XLA path
-stays the default until on-chip numbers justify the switch.
+QUARANTINED — hardware-retired, kept for the record. The 2026-08-01
+amortized on-chip campaign measured this kernel at 0.06x the XLA u64
+emulation on the murmur core (docs/artifacts/tpu_watch_20260801_0829/
+amortized.txt): XLA's generic emulation fuses the constant multiplies
+better than the 16-bit-limb schoolbook once the state machine is one
+elementwise pass. No default path selects it — activation requires
+BOTH hash_algo="murmur3" AND GALAH_TPU_PALLAS_HASH=1 (ops/hashing.py)
+— and its parity tests run only in the slow/hardware tier
+(tests/test_pallas_sketch.py). It stays in-tree as the reference
+16-bit-limb u64-multiply decomposition should a future Mosaic release
+change the economics.
 """
 
 from __future__ import annotations

@@ -27,6 +27,7 @@ sketch hashes at all).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -51,15 +52,59 @@ from galah_tpu.utils import timing
 # overrides either way.
 PAIR_BATCH = 8192
 
+# ---- survivor-evaluation strategy selection (AUTO) -------------------
+#
+# Three ways to evaluate the screen's survivors, picked per call from
+# survivor count and duplication factor (how many pairs each distinct
+# sketch row participates in), with the decision and per-strategy waste
+# recorded as timing counters in the stage report:
+#
+#   blocked — the P-pairs-per-program Mosaic pairlist kernel
+#             (ops/pallas_pairlist.py), the default device strategy;
+#   gather  — permute survivor rows into (GATHER_ROWS x GATHER_COLS)
+#             dense tiles and evaluate through the 27.3%-of-ceiling
+#             dense kernel (ops/pallas_pairwise.py), ignoring the
+#             unused cells; wins only when the survivors are so
+#             duplication-heavy (near-clique families) that tile fill
+#             beats the blocked kernel's rate;
+#   cpu     — a single host-side XLA-CPU evaluation for survivor
+#             counts too small to be worth even one device dispatch
+#             (each dispatch through a remote attach pays ~66 ms of
+#             RTT per BASELINE.md round-5 data).
+#
+# The rate constants are the round-5 hardware numbers (BASELINE.md
+# roofline table): the dense tile measured 218,077 pairs/s; the
+# blocked kernel is unmeasured until the next healthy tunnel window
+# (scripts/bench_pairlist_variants.py), so its estimate is the design
+# target — recalibrate both from hardware, or pin a strategy with
+# GALAH_TPU_PAIRLIST_STRATEGY=blocked|gather|xla|cpu.
+DENSE_RATE_EST = 218_077.0
+BLOCKED_RATE_EST = 200_000.0
+GATHER_MIN_DUP = 4.0     # don't even plan tiles below this duplication
+PAIRLIST_CPU_MAX = 256   # survivor count where one host eval wins
+GATHER_ROWS = 64         # unique a-rows per gather-dense tile
+GATHER_COLS = 128        # unique b-rows per gather-dense tile
+
 
 def _default_pair_batch() -> int:
-    import os
-
     env = os.environ.get("GALAH_TPU_PAIR_BATCH")
     if env:
         return max(1, int(env))
     return 4 * PAIR_BATCH if jax.default_backend() == "tpu" \
         else PAIR_BATCH
+
+
+def pair_block_quantum() -> int:
+    """Pairs per device evaluation block — callers sizing speculative
+    batches (cluster/engine.py) round up to a multiple of this so the
+    blocked pairlist kernel's programs run full."""
+    from galah_tpu.ops.hll import use_pallas_default
+
+    if not use_pallas_default():
+        return 1
+    from galah_tpu.ops.pallas_pairlist import pairlist_block_pairs
+
+    return pairlist_block_pairs()
 
 
 @functools.partial(
@@ -116,6 +161,189 @@ def _make_sharded_batch_stats(mesh: Mesh, sketch_size: int,
     return jax.jit(fn)
 
 
+def _plan_gather_segments(spi: np.ndarray, spj: np.ndarray,
+                          rows_cap: int = GATHER_ROWS,
+                          cols_cap: int = GATHER_COLS):
+    """Host plan for the gather-dense strategy: split a (pi, pj)-sorted
+    pair list into dense-tile jobs of at most `rows_cap` unique a-rows
+    x `cols_cap` unique b-rows. Every job is padded to the fixed caps
+    (repeating row 0 — its cells are computed and never read) so all
+    segments share ONE compiled tile shape.
+
+    Returns (segments, cells): segments is a list of
+    (ua, ub, ra, rb, idx) — gather indices (rows_cap,)/(cols_cap,),
+    per-pair tile coordinates, and the pair positions in the sorted
+    list; cells is the total padded tile area (the strategy's waste
+    denominator). O(S log S) numpy throughout — no per-pair Python."""
+    n = spi.shape[0]
+    # dense rank of each pair's a-row (pairs are a-sorted, so ranks are
+    # a prefix-sum over boundaries) -> blocks of rows_cap distinct a's
+    a_rank = np.zeros(n, dtype=np.int64)
+    if n > 1:
+        a_rank[1:] = np.cumsum(spi[1:] != spi[:-1])
+    block = a_rank // rows_cap
+    starts = np.flatnonzero(np.r_[True, block[1:] != block[:-1]])
+    bounds = np.r_[starts, n]
+    segments = []
+    for bi in range(len(starts)):
+        s, e = int(bounds[bi]), int(bounds[bi + 1])
+        ua_vals = np.unique(spi[s:e])
+        ub_all = np.unique(spj[s:e])
+        ra_all = (a_rank[s:e] - a_rank[s]).astype(np.int32)
+        pos_b = np.searchsorted(ub_all, spj[s:e]).astype(np.int64)
+        piece = pos_b // cols_cap
+        for t in range(int(piece.max()) + 1 if e > s else 0):
+            mask = piece == t
+            idx = np.flatnonzero(mask) + s
+            ua = np.zeros(rows_cap, dtype=np.int32)
+            ua[:ua_vals.size] = ua_vals
+            ub_piece = ub_all[t * cols_cap:(t + 1) * cols_cap]
+            ub = np.zeros(cols_cap, dtype=np.int32)
+            ub[:ub_piece.size] = ub_piece
+            segments.append((ua, ub, ra_all[mask],
+                             (pos_b[mask] - t * cols_cap).astype(np.int32),
+                             idx))
+    cells = len(segments) * rows_cap * cols_cap
+    return segments, cells
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("sketch_size", "interpret"))
+def _gather_tile_stats(jmat: jax.Array, ua: jax.Array, ub: jax.Array,
+                       sketch_size: int, interpret: bool = False):
+    """One gather-dense tile: permute the survivor rows and run the
+    dense Mosaic kernel over the full cross product."""
+    from galah_tpu.ops.pallas_pairwise import tile_stats_pallas
+
+    rows = jnp.take(jmat, ua, axis=0)
+    cols = jnp.take(jmat, ub, axis=0)
+    return tile_stats_pallas(rows, cols, sketch_size,
+                             interpret=interpret)
+
+
+def _gather_dense_pair_stats(
+    jmat: jax.Array,
+    pi32: np.ndarray,
+    pj32: np.ndarray,
+    sketch_size: int,
+    interpret: bool,
+    explicit: bool,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Evaluate the pair list through dense tiles (the gather-dense
+    strategy). Returns None when the dense kernel's Mosaic lowering
+    fails and the caller should re-run everything on the batched
+    fallback path (run_with_pallas_fallback policy: an explicit pin
+    propagates the failure instead)."""
+    from galah_tpu.ops._fallback import run_with_pallas_fallback
+
+    n_pairs = pi32.shape[0]
+    order = np.lexsort((pj32, pi32))
+    spi, spj = pi32[order], pj32[order]
+    segments, cells = _plan_gather_segments(spi, spj)
+    timing.counter("pairlist-gather-cells", int(cells))
+    timing.counter("pairlist-gather-used", int(n_pairs))
+
+    common = np.empty(n_pairs, dtype=np.int32)
+    total = np.empty(n_pairs, dtype=np.int32)
+
+    def eval_seg(seg, pallas: bool):
+        ua, ub, ra, rb, idx = seg
+        if not pallas:
+            raise RuntimeError(
+                "gather-dense has no non-Mosaic form")  # pragma: no cover
+        timing.dispatch()
+        return _gather_tile_stats(jmat, jnp.asarray(ua),
+                                  jnp.asarray(ub), sketch_size,
+                                  interpret=interpret)
+
+    def store_seg(seg, c, t):
+        ua, ub, ra, rb, idx = seg
+        timing.dispatch(sync=True)
+        common[order[idx]] = np.asarray(c)[ra, rb]
+        total[order[idx]] = np.asarray(t)[ra, rb]
+
+    # First tile eagerly through the fallback gate: a lowering failure
+    # here downgrades the whole strategy (return None -> caller redoes
+    # on the batched path) instead of half-filling the output.
+    try:
+        (c0, t0), pallas_used = run_with_pallas_fallback(
+            "gather-dense tile kernel", explicit, True,
+            lambda p: tuple(np.asarray(x)
+                            for x in eval_seg(segments[0], p)))
+    except RuntimeError:
+        if explicit:
+            raise
+        return None
+    if not pallas_used:  # pragma: no cover - fallback gate returned XLA
+        return None
+    store_seg(segments[0], c0, t0)
+
+    # Remaining tiles ride JAX's async dispatch queue; materialization
+    # failures downgrade the whole call (rare, mirrors downgrade_and_
+    # redo's recompute-everything-after-the-fault semantics).
+    futs = [(seg, eval_seg(seg, True)) for seg in segments[1:]]
+    try:
+        for seg, (c, t) in futs:
+            store_seg(seg, c, t)
+    except Exception:
+        if explicit:
+            raise
+        return None
+    return common, total
+
+
+def _cpu_pair_stats(sketch_mat: np.ndarray, pi32: np.ndarray,
+                    pj32: np.ndarray, sketch_size: int,
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Tiny survivor lists: one XLA-CPU evaluation on host — no device
+    dispatch, no batching, no padding."""
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        c, t = _batch_pair_stats(
+            jax.device_put(
+                np.ascontiguousarray(sketch_mat, dtype=np.uint64), cpu),
+            jax.device_put(pi32, cpu), jax.device_put(pj32, cpu),
+            sketch_size, use_pallas=False)
+        return np.asarray(c), np.asarray(t)
+
+
+def _resolve_pairlist_strategy(
+    pi32: np.ndarray,
+    pj32: np.ndarray,
+    use_pallas: bool,
+    explicit: bool,
+    mesh: Optional[Mesh],
+    batch: Optional[int],
+) -> str:
+    """AUTO strategy pick from survivor count and duplication factor.
+
+    GALAH_TPU_PAIRLIST_STRATEGY pins it. AUTO only deviates from the
+    historical batched path when nothing else is pinned: an explicit
+    use_pallas, a mesh, or a caller batch size all mean the caller
+    chose a shape — keep it (and parity/fault tests rely on that)."""
+    env = os.environ.get("GALAH_TPU_PAIRLIST_STRATEGY", "").lower()
+    if env in ("blocked", "gather", "xla", "cpu"):
+        return env
+    if not use_pallas:
+        return "xla"
+    if explicit or batch is not None or (
+            mesh is not None and mesh.devices.size > 1):
+        return "blocked"
+    n_pairs = int(pi32.shape[0])
+    if n_pairs <= PAIRLIST_CPU_MAX:
+        return "cpu"
+    uniq = np.union1d(pi32, pj32).size
+    dup = n_pairs / max(uniq, 1)
+    if dup < GATHER_MIN_DUP:
+        return "blocked"
+    order = np.lexsort((pj32, pi32))
+    _, cells = _plan_gather_segments(pi32[order], pj32[order])
+    fill = n_pairs / max(cells, 1)
+    if fill * DENSE_RATE_EST > BLOCKED_RATE_EST:
+        return "gather"
+    return "blocked"
+
+
 def pair_stats_for_pairs(
     sketch_mat: np.ndarray,
     pi: np.ndarray,
@@ -134,6 +362,14 @@ def pair_stats_for_pairs(
     sharded over the mesh axis. use_pallas selects the Mosaic pairlist
     kernel (default: on for TPU backends, with XLA fallback on a
     lowering failure — explicit True pins it, failures propagate).
+
+    On the default path an AUTO strategy pick (see the module's
+    strategy block) may reroute the evaluation through the gather-dense
+    tiles or a single host XLA-CPU shot; the decision and each
+    strategy's waste land in the timing counters
+    (pairlist-strategy-*, pairlist-gather-cells/used,
+    pairlist-pad-slots, pairlist-blocked-pad-pairs). All strategies
+    produce bit-identical integers (tests/test_pallas_pairlist.py).
     """
     n_pairs = int(pi.shape[0])
     common = np.empty(n_pairs, dtype=np.int32)
@@ -147,7 +383,27 @@ def pair_stats_for_pairs(
 
         use_pallas = use_pallas_default()
 
+    pi32 = np.ascontiguousarray(pi, dtype=np.int32)
+    pj32 = np.ascontiguousarray(pj, dtype=np.int32)
+    strategy = _resolve_pairlist_strategy(pi32, pj32, bool(use_pallas),
+                                          explicit, mesh, batch)
+    timing.counter(f"pairlist-strategy-{strategy}", 1)
+    if strategy == "cpu":
+        return _cpu_pair_stats(sketch_mat, pi32, pj32, sketch_size)
+    if strategy == "xla":
+        use_pallas = False
+
     jmat = jnp.asarray(np.ascontiguousarray(sketch_mat, dtype=np.uint64))
+    if strategy == "gather":
+        got = _gather_dense_pair_stats(jmat, pi32, pj32, sketch_size,
+                                       interpret, explicit)
+        if got is not None:
+            return got
+        # dense-kernel downgrade: the batched XLA path below redoes
+        # everything (mirror of downgrade_and_redo)
+        use_pallas = False
+        timing.counter("pairlist-gather-downgraded", 1)
+
     n_dev = mesh.devices.size if mesh is not None else 1
     if batch is None:
         batch = _default_pair_batch()
@@ -164,9 +420,16 @@ def pair_stats_for_pairs(
 
     from galah_tpu.ops._fallback import run_with_pallas_fallback
 
-    pi32 = np.ascontiguousarray(pi, dtype=np.int32)
-    pj32 = np.ascontiguousarray(pj, dtype=np.int32)
     starts = list(range(0, n_pairs, b))
+    # Waste on the record: zero-padded slots in the final partial batch
+    # plus, on the blocked kernel path, the sentinel pairs each
+    # dispatch adds to fill its last P-pair program.
+    timing.counter("pairlist-pad-slots", len(starts) * b - n_pairs)
+    if use_pallas:
+        from galah_tpu.ops.pallas_pairlist import pairlist_block_pairs
+
+        timing.counter("pairlist-blocked-pad-pairs",
+                       len(starts) * (-b % pairlist_block_pairs()))
 
     def dispatch(fn, s):
         e = min(s + b, n_pairs)

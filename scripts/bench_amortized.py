@@ -160,7 +160,7 @@ def main():
     pa = dev(pool[rng.integers(0, 1024, size=b)])
     pb = dev(pool[rng.integers(0, 1024, size=b)])
 
-    def make_pairlist(range_skip):
+    def make_pairlist(range_skip, block_pairs=None):
         def make_fn(reps):
             @jax.jit
             def run():
@@ -168,7 +168,8 @@ def main():
                     aa, bb = jax.lax.optimization_barrier((pa, pb))
                     cm, tt = pair_stats_pairs_pallas(
                         aa, bb, K, interpret=interpret,
-                        range_skip=range_skip)
+                        range_skip=range_skip,
+                        block_pairs=block_pairs)
                     return acc + jnp.sum(cm, dtype=jnp.int32) \
                         + jnp.sum(tt, dtype=jnp.int32)
                 return jax.lax.fori_loop(
@@ -176,10 +177,19 @@ def main():
             return lambda: int(np.asarray(run()))
         return make_fn
 
-    for skip in ((False,) if args.fast else (False, True)):
-        label = f"pairlist B={b}" + ("+skip" if skip else "")
+    from galah_tpu.ops.pallas_pairlist import pairlist_block_pairs
+
+    P = pairlist_block_pairs()
+    # blocked production default, plus the retired one-pair grid as
+    # the A/B baseline (the round-5 62.8k pairs/s configuration)
+    variants = [(False, P, f"pairlist B={b} P={P}"),
+                (False, 1, f"pairlist B={b} P=1")]
+    if not args.fast:
+        variants.append((True, 1, f"pairlist B={b} P=1+skip"))
+    for skip, bp, label in variants:
         per, disp, sus, ok = _measure_amortized(
-            make_pairlist(skip), *((1, 3) if interpret else (1, 6)))
+            make_pairlist(skip, block_pairs=bp),
+            *((1, 3) if interpret else (1, 6)))
         _row(label, b, per, disp, sus, ok, PAIR_CEILING, results)
 
     # --- murmur3 sketch core: Mosaic kernel vs XLA u64 emulation ---

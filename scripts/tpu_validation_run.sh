@@ -6,9 +6,25 @@
 # a successful session is committable as-is.
 set -u
 LOG=/root/repo/scripts/tpu_validation.log
+
+# SINGLE-CLIENT TUNNEL LOCK: the round-5 08:39 capture died when two
+# clients shared one chip (a manual run raced the watcher's). Every
+# invocation path re-execs itself under an exclusive flock on the
+# shared lock file, held for the whole session, so every tunnel-using
+# child (probe, pytest, bench, ladder) runs single-client by
+# construction. GALAH_TUNNEL_LOCKED short-circuits the re-exec when a
+# caller (the watcher) already wrapped us in the same lock.
+LOCKFILE=${GALAH_TPU_TUNNEL_LOCK:-/tmp/galah_tpu_tunnel.lock}
+if [ "${GALAH_TUNNEL_LOCKED:-}" != 1 ]; then
+  echo "=== acquiring tunnel lock $LOCKFILE $(date -u) ===" >> "$LOG"
+  # flock exits 1 if the wait expires (another client held the chip
+  # past 300 s) and that becomes this script's exit status.
+  exec env GALAH_TUNNEL_LOCKED=1 flock -w 300 "$LOCKFILE" bash "$0" "$@"
+fi
+
 ART=/root/repo/docs/artifacts/tpu_watch_$(date -u +%Y%m%d_%H%M)
 cd /root/repo
-echo "=== tpu_validation_run $(date -u) ===" >> "$LOG"
+echo "=== tpu_validation_run (tunnel lock held) $(date -u) ===" >> "$LOG"
 
 for attempt in $(seq 1 60); do
   t0=$(date +%s)

@@ -5,8 +5,16 @@
 # a sentinel file so the builder notices and commits them.
 set -u
 LOG=/root/repo/scripts/tpu_validation.log
+# Same single-client tunnel lock as tpu_validation_run.sh: the watcher
+# takes it explicitly around each spawn (GALAH_TUNNEL_LOCKED=1 tells
+# the child not to re-acquire) so a manually-launched validation run
+# and a watcher-spawned one can never share the chip — the round-5
+# 08:39 contention mode. -w 600: a manual session should finish its
+# stage soon; if not, this iteration gives up and the loop re-probes.
+LOCKFILE=${GALAH_TPU_TUNNEL_LOCK:-/tmp/galah_tpu_tunnel.lock}
 while true; do
-  if bash /root/repo/scripts/tpu_validation_run.sh; then
+  if env GALAH_TUNNEL_LOCKED=1 flock -w 600 "$LOCKFILE" \
+      bash /root/repo/scripts/tpu_validation_run.sh; then
     # A zero exit only means a probe attached; run_stage swallows stage
     # failures. Declare the capture done only if the bench stage itself
     # exited 0 — otherwise keep probing (the tunnel may have flapped).

@@ -102,9 +102,13 @@ def test_degenerate_genomes_cluster_alone(tmp_path, pre):
     assert sorted(sorted(c) for c in clusters) == [[0], [1], [2]]
 
 
+@pytest.mark.slow
 def test_threads_parity_clusters(tmp_path):
     """--threads N produces identical clusters to --threads 1 (the
-    threaded CPU sketch/profile fan-out is order-independent)."""
+    threaded CPU sketch/profile fan-out is order-independent).
+    Slow tier: compile-bound parity variant — two full cluster runs
+    over six 30 kb genomes; the golden cluster tests pin the
+    single-thread integers every run."""
     import numpy as np
 
     from galah_tpu.api import generate_galah_clusterer

@@ -189,9 +189,13 @@ def test_batch_sketch_tiny_budget_groups(tmp_path):
         np.testing.assert_array_equal(x.hashes, y.hashes)
 
 
+@pytest.mark.slow
 def test_preclusterer_batched_branch_matches(tmp_path, monkeypatch):
     """The backend's TPU-policy batched sketch branch produces the same
-    pair cache as the per-genome CPU branch."""
+    pair cache as the per-genome CPU branch. Slow tier: compile-bound
+    XLA-CPU parity (two full sketch-compile pipelines over 40 kb
+    genomes); the branch's integers are also pinned by the golden
+    cluster tests whenever the TPU policy is active."""
     from galah_tpu.backends.minhash_backend import MinHashPreclusterer
     from galah_tpu.io.diskcache import CacheDir
 

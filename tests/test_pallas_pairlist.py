@@ -36,9 +36,14 @@ def _xla_pairs(a, b, sketch_size):
 # case as the per-commit smoke parity.
 @pytest.mark.parametrize("range_skip", [
     False, pytest.param(True, marks=pytest.mark.slow)])
+@pytest.mark.slow
 @pytest.mark.parametrize("n_pairs,width", [
-    (130, 256), pytest.param(64, 1024, marks=pytest.mark.slow)])
+    (21, 256), (130, 256), (64, 1024)])
 def test_pairlist_matches_xla(n_pairs, width, range_skip):
+    """Random-list parity across widths. Slow tier: each (shape,
+    width) pays a ~5 s interpret-mode trace regardless of pair count;
+    tier-1 parity for this kernel lives in test_pairlist_edge_rows and
+    test_blocked_pair_axis_boundaries (width 128, shared traces)."""
     rng = np.random.default_rng(n_pairs)
     mat = _rand_sketches(rng, 80, width)
     # overlapping families so commons are non-trivial
@@ -97,6 +102,66 @@ def test_pairlist_respects_sketch_size_cap():
     np.testing.assert_array_equal(np.asarray(got_t), want_t)
 
 
+@pytest.mark.parametrize("n_pairs", [7, 8, 9])
+def test_blocked_pair_axis_boundaries(n_pairs):
+    """P-1 / P / P+1 pairs at the default block (P=8): the pair-axis
+    sentinel padding must fill partial blocks without leaking into
+    real outputs, and a full block plus one must spill into a second
+    grid step correctly."""
+    rng = np.random.default_rng(40 + n_pairs)
+    width = 128
+    mat = _rand_sketches(rng, 12, width)
+    pi = rng.integers(0, 12, size=n_pairs)
+    pj = rng.integers(0, 12, size=n_pairs)
+    a, b = mat[pi], mat[pj]
+    want_c, want_t = _xla_pairs(a, b, width)
+    got_c, got_t = pair_stats_pairs_pallas(
+        jnp.asarray(a), jnp.asarray(b), width, interpret=True,
+        block_pairs=8)
+    np.testing.assert_array_equal(np.asarray(got_c), want_c)
+    np.testing.assert_array_equal(np.asarray(got_t), want_t)
+
+
+# Default tier already covers the production P=8 blocked kernel
+# (boundaries above + the random-matrix case); the cross-P sweep is
+# tracing-bound in interpret mode, so it rides the slow tier.
+@pytest.mark.slow
+@pytest.mark.parametrize("block_pairs", [1, 2, 4, 8])
+def test_blocked_matches_xla_across_block_sizes(block_pairs):
+    """Every supported P yields the same integers (P=1 is the retired
+    round-5 one-pair grid; a ragged 13-pair list is partial for every
+    P here)."""
+    rng = np.random.default_rng(50 + block_pairs)
+    width = 256
+    mat = _rand_sketches(rng, 20, width)
+    mat[4] = np.uint64(SENTINEL)            # empty row in the list
+    pi = rng.integers(0, 20, size=13)
+    pj = rng.integers(0, 20, size=13)
+    a, b = mat[pi], mat[pj]
+    want_c, want_t = _xla_pairs(a, b, width)
+    got_c, got_t = pair_stats_pairs_pallas(
+        jnp.asarray(a), jnp.asarray(b), width, interpret=True,
+        block_pairs=block_pairs)
+    np.testing.assert_array_equal(np.asarray(got_c), want_c)
+    np.testing.assert_array_equal(np.asarray(got_t), want_t)
+
+
+def test_block_env_knob(monkeypatch):
+    """GALAH_TPU_PAIRLIST_BLOCK tunes P; it is resolved OUTSIDE the jit
+    cache so a change takes effect on the next call."""
+    from galah_tpu.ops.pallas_pairlist import (
+        PAIRLIST_BLOCK_DEFAULT,
+        pairlist_block_pairs,
+    )
+
+    monkeypatch.delenv("GALAH_TPU_PAIRLIST_BLOCK", raising=False)
+    assert pairlist_block_pairs() == PAIRLIST_BLOCK_DEFAULT
+    monkeypatch.setenv("GALAH_TPU_PAIRLIST_BLOCK", "4")
+    assert pairlist_block_pairs() == 4
+    monkeypatch.setenv("GALAH_TPU_PAIRLIST_BLOCK", "0")
+    assert pairlist_block_pairs() == 1
+
+
 def test_wired_sparse_batch_path_interpret():
     """The production wiring (pair_stats_for_pairs with the pallas
     route, batch pad/trim included) matches the XLA route — interpret
@@ -104,13 +169,16 @@ def test_wired_sparse_batch_path_interpret():
     from galah_tpu.ops.sparse_device import pair_stats_for_pairs
 
     rng = np.random.default_rng(21)
-    mat = _rand_sketches(rng, 60, 256)
-    pi = rng.integers(0, 60, size=333)
-    pj = rng.integers(0, 60, size=333)
-    c_xla, t_xla = pair_stats_for_pairs(mat, pi, pj, 256,
+    # width 128 (one lane quantum) keeps the interpret-mode trace
+    # cheap; 56 pairs / batch 48 gives two batches, the second ragged,
+    # covering the pad/trim seam
+    mat = _rand_sketches(rng, 60, 128)
+    pi = rng.integers(0, 60, size=56)
+    pj = rng.integers(0, 60, size=56)
+    c_xla, t_xla = pair_stats_for_pairs(mat, pi, pj, 128,
                                         use_pallas=False)
-    c_pl, t_pl = pair_stats_for_pairs(mat, pi, pj, 256,
+    c_pl, t_pl = pair_stats_for_pairs(mat, pi, pj, 128,
                                       use_pallas=True, interpret=True,
-                                      batch=128)
+                                      batch=48)
     np.testing.assert_array_equal(c_pl, c_xla)
     np.testing.assert_array_equal(t_pl, t_xla)

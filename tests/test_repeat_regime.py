@@ -157,6 +157,57 @@ def test_e2e_10pct_repeats_finch_default_no_merges():
     assert len(_cluster(paths)) == 16
 
 
+def test_repeat_merge_hazard_warning():
+    """A marginal, direction-asymmetric gate pass (the pair-(9,14)
+    signature above: AF 4/17 = 0.235 passing a 0.15 threshold while
+    the other direction sits at 1/17) raises the repeat-merge hazard
+    RuntimeWarning on the scalar combine path; symmetric passes and
+    comfortable margins stay silent."""
+    import warnings
+
+    from galah_tpu.ops.fragment_ani import (
+        DirectedANI,
+        _combine_bidirectional,
+    )
+
+    hazard_ab = DirectedANI(0.973, 4 / 17, 4, 17)
+    hazard_ba = DirectedANI(0.970, 1 / 17, 1, 17)
+    with pytest.warns(RuntimeWarning, match="min-aligned-fraction"):
+        got = _combine_bidirectional(hazard_ab, hazard_ba, 0.15)
+    assert got == 0.973
+
+    sym_ab = DirectedANI(0.99, 0.20, 4, 20)
+    sym_ba = DirectedANI(0.99, 0.25, 5, 20)
+    wide_ab = DirectedANI(0.99, 0.90, 18, 20)
+    wide_ba = DirectedANI(0.99, 0.10, 2, 20)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        assert _combine_bidirectional(sym_ab, sym_ba, 0.15) == 0.99
+        assert _combine_bidirectional(wide_ab, wide_ba, 0.15) == 0.99
+
+
+def test_repeat_merge_hazard_warning_arrays_path():
+    """The batched-C arrays path in bidirectional_ani_values fires the
+    same warning (it bypasses _combine_bidirectional entirely)."""
+    from galah_tpu.io.fasta import read_genome
+    from galah_tpu.ops.fragment_ani import (
+        bidirectional_ani_values,
+        build_profile,
+    )
+
+    pytest.importorskip("galah_tpu.ops._cpairstats")
+    paths = bench._synth_repeat_genomes(
+        n_genomes=16, genome_len=50_000, repeat_frac=0.1, seed=23)
+    profs = [build_profile(read_genome(p), k=21, fraglen=3000)
+             for p in paths]
+    # all pairs: >= 64 directed jobs selects the arrays path on CPU
+    pairs = [(profs[i], profs[j])
+             for i in range(16) for j in range(i + 1, 16)]
+    with pytest.warns(RuntimeWarning, match="min-aligned-fraction"):
+        vals = bidirectional_ani_values(pairs, min_aligned_frac=0.15)
+    assert any(v is not None for v in vals)
+
+
 @pytest.mark.slow
 def test_e2e_repeat_merge_behavior_pinned():
     """The RECORDED adversarial behavior (see module docstring): the

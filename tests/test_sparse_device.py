@@ -265,6 +265,129 @@ def test_mosaic_midstream_failure_explicit_pin_raises(monkeypatch, mode):
                              use_pallas=True)
 
 
+@pytest.mark.parametrize("shape", ["padded", "ragged"])
+def test_gather_dense_strategy_parity(shape, monkeypatch):
+    """The gather-dense strategy (dense tiles over permuted survivor
+    rows, ops/sparse_device._gather_dense_pair_stats) is bit-identical
+    to the XLA route, for a survivor list that exactly fills the tile
+    caps and for a ragged one spanning multiple row blocks and column
+    pieces."""
+    from galah_tpu.utils import timing
+
+    rng = np.random.default_rng(17)
+    n = 80
+    mat = _family_sketches(n=n, width=48, n_fam=10, seed=17,
+                           mutations=8)
+    if shape == "padded":
+        # one full tile: GATHER_ROWS distinct a's, each paired once
+        pi = np.arange(sparse_device.GATHER_ROWS, dtype=np.int64) % n
+        pj = (pi + 1) % n
+    else:
+        # > GATHER_ROWS unique a's (second row block) and pair counts
+        # that are not multiples of anything convenient
+        pi = rng.integers(0, n - 1, size=333).astype(np.int64)
+        pj = np.minimum(pi + 1 + rng.integers(0, 30, size=333), n - 1)
+    want_c, want_t = pair_stats_for_pairs(mat, pi, pj, mat.shape[1],
+                                          use_pallas=False)
+    import jax
+    import jax.numpy as jnp
+
+    timing.reset()
+    got = sparse_device._gather_dense_pair_stats(
+        jax.device_put(jnp.asarray(mat)),
+        pi.astype(np.int32), pj.astype(np.int32), mat.shape[1],
+        interpret=True, explicit=True)
+    assert got is not None
+    np.testing.assert_array_equal(got[0], want_c)
+    np.testing.assert_array_equal(got[1], want_t)
+    counters = timing.GLOBAL.counters()
+    assert counters["pairlist-gather-used"] == pi.shape[0]
+    assert counters["pairlist-gather-cells"] >= pi.shape[0]
+
+
+@pytest.mark.slow
+def test_strategy_env_pins_every_route(monkeypatch):
+    """GALAH_TPU_PAIRLIST_STRATEGY pins each route end-to-end through
+    pair_stats_for_pairs with identical integers, and the decision
+    counter records the pick. Slow tier: three interpret-mode kernel
+    traces; tier-1 keeps per-route bit-identity (boundaries/gather
+    parity tests) and the AUTO selection test below."""
+    from galah_tpu.utils import timing
+
+    mat = _family_sketches(n=90, width=48, n_fam=9, seed=23,
+                           mutations=8)
+    rng = np.random.default_rng(23)
+    # 40 pairs: enough for multiple blocked grid steps while keeping
+    # the interpret-mode grid walk short (gather parity across tile
+    # shapes is pinned separately above)
+    pi = rng.integers(0, 89, size=40).astype(np.int64)
+    pj = np.minimum(pi + 1 + rng.integers(0, 20, size=40), 89)
+    monkeypatch.delenv("GALAH_TPU_PAIRLIST_STRATEGY", raising=False)
+    want_c, want_t = pair_stats_for_pairs(mat, pi, pj, mat.shape[1],
+                                          use_pallas=False)
+    for strat in ("cpu", "gather", "blocked"):
+        monkeypatch.setenv("GALAH_TPU_PAIRLIST_STRATEGY", strat)
+        timing.reset()
+        got_c, got_t = pair_stats_for_pairs(
+            mat, pi, pj, mat.shape[1], use_pallas=True, interpret=True)
+        np.testing.assert_array_equal(got_c, want_c)
+        np.testing.assert_array_equal(got_t, want_t)
+        counters = timing.GLOBAL.counters()
+        assert counters.get(f"pairlist-strategy-{strat}") == 1, strat
+
+
+def test_auto_strategy_selection_regimes(monkeypatch):
+    """The AUTO heuristic (ops/sparse_device._resolve_pairlist_strategy)
+    picks cpu for tiny lists, gather only for duplication-heavy lists
+    whose planned tile fill clears the rate crossover, blocked
+    otherwise — and never deviates when the caller pinned a shape."""
+    monkeypatch.delenv("GALAH_TPU_PAIRLIST_STRATEGY", raising=False)
+    resolve = sparse_device._resolve_pairlist_strategy
+
+    tiny = np.arange(10, dtype=np.int32)
+    assert resolve(tiny, tiny + 1, True, False, None, None) == "cpu"
+    assert resolve(tiny, tiny + 1, False, False, None, None) == "xla"
+    # caller pins (explicit use_pallas / batch) keep the batched path
+    assert resolve(tiny, tiny + 1, True, True, None, None) == "blocked"
+    assert resolve(tiny, tiny + 1, True, False, None, 64) == "blocked"
+
+    # low duplication at scale: scattered pairs over many rows
+    rng = np.random.default_rng(7)
+    pi = np.arange(2000, dtype=np.int32)
+    pj = (pi + 1 + rng.integers(0, 5, size=2000).astype(np.int32))
+    assert resolve(pi, pj, True, False, None, None) == "blocked"
+
+    # 32-member family cliques: dup ~15.5 but each planned tile is
+    # only ~12% full — not enough to beat the blocked kernel's design
+    # rate, so AUTO stays blocked despite the duplication
+    m = 32
+    ii, jj = np.meshgrid(np.arange(m, dtype=np.int32),
+                         np.arange(m, dtype=np.int32), indexing="ij")
+    keep = ii < jj
+    cpi = np.concatenate([ii[keep] + f * m for f in range(8)])
+    cpj = np.concatenate([jj[keep] + f * m for f in range(8)])
+    assert resolve(cpi, cpj, True, False, None, None) == "blocked"
+    # ...unless the blocked kernel were slow enough that even 12%-full
+    # dense tiles out-run it (rate crossover is live, not vestigial)
+    monkeypatch.setattr(sparse_device, "BLOCKED_RATE_EST", 20_000.0)
+    assert resolve(cpi, cpj, True, False, None, None) == "gather"
+    monkeypatch.setattr(sparse_device, "BLOCKED_RATE_EST", 200_000.0)
+
+    # dense bipartite block (GATHER_ROWS x GATHER_COLS all-pairs):
+    # fill 1.0 — the regime gather-dense exists for
+    ga = np.repeat(np.arange(sparse_device.GATHER_ROWS,
+                             dtype=np.int32),
+                   sparse_device.GATHER_COLS)
+    gb = np.tile(np.arange(sparse_device.GATHER_COLS,
+                           dtype=np.int32)
+                 + sparse_device.GATHER_ROWS,
+                 sparse_device.GATHER_ROWS)
+    assert resolve(ga, gb, True, False, None, None) == "gather"
+
+    monkeypatch.setenv("GALAH_TPU_PAIRLIST_STRATEGY", "gather")
+    assert resolve(tiny, tiny + 1, True, False, None, None) == "gather"
+
+
 def test_dispatch_counters_recorded(monkeypatch):
     """The sparse device pipeline records disp/sync counters under the
     active stage — the per-stage round-trip visibility the TPU e2e
