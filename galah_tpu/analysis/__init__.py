@@ -1,0 +1,170 @@
+"""galah-tpu lint: static analysis for the JAX/Pallas codebase.
+
+Run as ``python -m galah_tpu.analysis`` or ``galah-tpu lint``. Exit
+status is 1 iff any unsuppressed finding at WARNING or above remains
+(INFO notes never fail the run).
+
+Checker families
+  GL1xx  Pallas kernel contracts (tiling quanta, VMEM budget, 64-bit)
+  GL2xx  host-sync / tracer leaks inside jitted bodies
+  GL3xx  recompile churn (env reads in jit, unhashable static args)
+  GL4xx  GALAH_* config-flag registry consistency
+  GL5xx  abstract-eval shape contracts vs committed snapshot
+  GL6xx  hardware-test marker audit
+
+Suppression: ``# galah-lint: ignore[GL103]`` on the flagged line or
+the line above, or an entry in the committed baseline
+(``galah_tpu/analysis/baseline.json``, regenerated with
+``--update-baseline``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from galah_tpu.analysis import core
+from galah_tpu.analysis.core import Finding, Severity, SourceFile
+
+CHECK_NAMES = ("pallas", "runtime", "flags", "markers", "shapes")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+def repo_root() -> str:
+    """The directory holding the galah_tpu package (repo checkout)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def load_sources(root: str) -> Dict[str, SourceFile]:
+    sources: Dict[str, SourceFile] = {}
+    for path in core.iter_python_files(root):
+        try:
+            src = SourceFile.load(path, rel_to=root)
+        except SyntaxError:
+            continue  # not lintable; the test suite will catch it
+        sources[src.path] = src
+    return sources
+
+
+def run_checks(sources: Dict[str, SourceFile],
+               checks: Sequence[str] = CHECK_NAMES) -> List[Finding]:
+    """All requested checkers over the loaded tree (no suppression
+    applied yet)."""
+    findings: List[Finding] = []
+    if "pallas" in checks:
+        from galah_tpu.analysis.pallas_check import check_pallas_file
+        for src in sources.values():
+            findings.extend(check_pallas_file(src))
+    if "runtime" in checks:
+        from galah_tpu.analysis.runtime_checks import check_runtime_file
+        for src in sources.values():
+            findings.extend(check_runtime_file(src))
+    if "flags" in checks:
+        from galah_tpu.analysis.flags_check import check_flag_references
+        findings.extend(check_flag_references(list(sources.values())))
+    if "markers" in checks:
+        from galah_tpu.analysis.markers_check import check_markers_file
+        for src in sources.values():
+            findings.extend(check_markers_file(src))
+    if "shapes" in checks:
+        from galah_tpu.analysis.shapes import check_shape_contracts
+        findings.extend(check_shape_contracts())
+    return findings
+
+
+def run_lint(root: Optional[str] = None,
+             checks: Sequence[str] = CHECK_NAMES,
+             baseline_path: Optional[str] = None) -> List[Finding]:
+    """Full lint pass with suppressions applied; the library entry
+    point used by tests and the CLI."""
+    root = root or repo_root()
+    sources = load_sources(root)
+    findings = run_checks(sources, checks)
+    baseline = core.load_baseline(baseline_path or DEFAULT_BASELINE)
+    core.apply_suppressions(findings, sources, baseline)
+    return findings
+
+
+def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON report on stdout")
+    parser.add_argument("--root", default=None,
+                        help="repo root to scan (default: the checkout "
+                             "containing this package)")
+    parser.add_argument("--check", action="append", default=None,
+                        choices=CHECK_NAMES, dest="checks",
+                        metavar="NAME",
+                        help="run only the named checker family "
+                             "(repeatable; default: all of "
+                             + ", ".join(CHECK_NAMES) + ")")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file of accepted findings "
+                             "(default: galah_tpu/analysis/"
+                             "baseline.json)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to accept every "
+                             "current finding, then exit 0")
+    parser.add_argument("--update-snapshots", action="store_true",
+                        help="recompute and commit the abstract-eval "
+                             "shape-contract snapshot, then exit 0")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="include suppressed findings in the "
+                             "human report")
+
+
+def main(argv: Optional[Sequence[str]] = None,
+         args: Optional[argparse.Namespace] = None) -> int:
+    if args is None:
+        parser = argparse.ArgumentParser(
+            prog="galah-tpu lint",
+            description=__doc__,
+            formatter_class=argparse.RawDescriptionHelpFormatter)
+        add_lint_arguments(parser)
+        args = parser.parse_args(argv)
+
+    t0 = time.monotonic()
+    if args.update_snapshots:
+        from galah_tpu.analysis import shapes
+        contracts, errors = shapes.compute_contracts()
+        if errors:
+            sys.stderr.write(core.render_human(errors) + "\n")
+            return 1
+        shapes.write_snapshot(contracts)
+        n = sum(len(v) for v in contracts.values())
+        print(f"wrote {n} shape contracts for {len(contracts)} ops "
+              f"to {shapes.SNAPSHOT_PATH}")
+        return 0
+
+    root = args.root or repo_root()
+    checks = tuple(args.checks) if args.checks else CHECK_NAMES
+    sources = load_sources(root)
+    findings = run_checks(sources, checks)
+    baseline_path = args.baseline or DEFAULT_BASELINE
+
+    if args.update_baseline:
+        # inline suppressions still apply; the baseline absorbs the rest
+        core.apply_suppressions(findings, sources, {})
+        remaining = [f for f in findings if not f.suppressed]
+        core.write_baseline(baseline_path, remaining)
+        print(f"baselined {len(remaining)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baseline = core.load_baseline(baseline_path)
+    core.apply_suppressions(findings, sources, baseline)
+    bad = core.failing(findings)
+
+    if args.json:
+        print(core.render_json(findings))
+    else:
+        print(core.render_human(
+            findings, show_suppressed=args.show_suppressed))
+        dt = time.monotonic() - t0
+        print(f"scanned {len(sources)} files with "
+              f"{len(checks)} checker families in {dt:.1f}s")
+    return 1 if bad else 0

@@ -1,0 +1,17 @@
+"""``python -m galah_tpu.analysis`` — run the lint suite standalone.
+
+Pins the platform to CPU (the shape harness only abstract-evals, no
+device needed) and enables x64 so the uint64 sketch ops trace with
+their real dtypes, BEFORE jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+from galah_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

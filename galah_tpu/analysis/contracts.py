@@ -1,0 +1,129 @@
+"""TPU kernel-contract model: tiling quanta, VMEM budget, annotations.
+
+The hardware facts the Pallas checker enforces (Mosaic's tile rules;
+see the Pallas TPU guide):
+
+  * the last (lane) dimension of every VMEM block is quantized to 128;
+  * the second-to-last (sublane) dimension is quantized per dtype
+    width — 8 for 4-byte, 16 for 2-byte, 32 for 1-byte elements;
+  * VMEM is ~16 MiB per core; the checker budgets all of a program's
+    resident blocks (in + out + scratch) against that with a safety
+    factor, because Mosaic's double-buffered pipelining can hold two
+    copies of the streamed blocks;
+  * the TPU has no 64-bit integer unit: a u64/i64/f64 dtype at a
+    kernel boundary is a latent hardware failure (this repo emulates
+    u64 as hi/lo u32 planes on purpose — see ops/pallas_pairwise).
+
+Kernel modules declare a machine-readable ``PALLAS_CONTRACT`` — a plain
+dict literal (harvested from the AST via ``ast.literal_eval``, no
+import) keyed by the function that issues each ``pl.pallas_call``:
+
+    PALLAS_CONTRACT = {
+        "my_kernel_caller": {
+            # representative *maximum* values for call-site locals the
+            # BlockSpec shape expressions reference
+            "bindings": {"bc": 512, "k_pad": 1024},
+            # dtype of each in_specs block, in order (u32 planes etc.)
+            "in_dtypes": ["uint32", "uint32"],
+            # functions whose bodies are (or build) the kernel body —
+            # scanned for 64-bit dtype references
+            "kernel_fns": ["_make_kernel"],
+            # optional overrides
+            "vmem_budget_bytes": 16777216,
+            "vmem_safety": 0.5,
+        },
+    }
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+#: Lane quantum: the last dim of every VMEM block tile.
+LANE_QUANTUM = 128
+
+#: Sublane quantum by element width in bytes (Mosaic min tiles:
+#: float32 (8, 128), bfloat16 (16, 128), int8/fp8 (32, 128)).
+SUBLANE_QUANTUM_BY_ITEMSIZE = {4: 8, 2: 16, 1: 32}
+
+#: Per-core VMEM and the default fraction of it a single program's
+#: resident blocks may claim (double buffering halves the usable half).
+VMEM_BYTES = 16 * 1024 * 1024
+VMEM_SAFETY_DEFAULT = 0.5
+
+#: dtypes with no TPU hardware support — 64-bit anything.
+BANNED_DTYPES = ("uint64", "int64", "float64")
+
+ITEMSIZE = {
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "bool_": 1,
+    "float64": 8, "int64": 8, "uint64": 8,
+}
+
+
+def dtype_itemsize(dtype: str) -> Optional[int]:
+    return ITEMSIZE.get(dtype)
+
+
+def sublane_quantum(dtype: str) -> int:
+    size = ITEMSIZE.get(dtype, 4)
+    return SUBLANE_QUANTUM_BY_ITEMSIZE.get(size, 8)
+
+
+def dtype_from_node(node: ast.AST) -> Optional[str]:
+    """'int32' from an AST reference like ``jnp.int32`` / ``np.uint8``
+    / ``"float32"``; None when the node is not a recognizable dtype."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in ITEMSIZE else None
+    if isinstance(node, ast.Attribute) and node.attr in ITEMSIZE:
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in ITEMSIZE:
+        return node.id
+    return None
+
+
+def harvest_contract(tree: ast.Module) -> Optional[Dict[str, dict]]:
+    """The module's ``PALLAS_CONTRACT`` dict literal, or None.
+
+    literal_eval keeps the annotation machine-readable by construction:
+    a contract that needs computed values is a smell (the checker's
+    bindings exist precisely to stand in for runtime values).
+    """
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "PALLAS_CONTRACT":
+                try:
+                    value = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return None
+                if isinstance(value, dict):
+                    return value
+    return None
+
+
+def module_int_constants(tree: ast.Module) -> Dict[str, int]:
+    """Module-level ``NAME = <int literal>`` assignments — the tile
+    constants (A_SUB, B_LANE, LANES, ...) BlockSpec shapes reference."""
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if len(node.targets) != 1 or not isinstance(
+                node.targets[0], ast.Name):
+            continue
+        try:
+            value = ast.literal_eval(node.value)
+        except (ValueError, SyntaxError):
+            continue
+        if isinstance(value, (int, bool)):
+            out[node.targets[0].id] = int(value)
+    return out

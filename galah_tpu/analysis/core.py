@@ -1,0 +1,321 @@
+"""Lint framework: findings, severities, suppressions, baseline, reports.
+
+Every checker in this package produces :class:`Finding` objects; this
+module owns everything around them — the severity lattice, inline
+suppression comments (``# galah-lint: ignore[GL103]`` on the flagged
+line or the line above), the committed baseline file (fingerprints of
+accepted findings, stable across unrelated line moves), and the human /
+JSON renderings.
+
+Checkers are purely static where possible (AST over source text); the
+abstract-eval harness (shapes.py) is the one checker that imports the
+ops, but still never compiles or executes a kernel.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import enum
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # noqa: D105 - render as lowercase word
+        return self.name.lower()
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding, anchored to a file/line."""
+
+    code: str              # e.g. "GL103"
+    severity: Severity
+    path: str              # repo-relative
+    line: int              # 1-based; 0 for file-level findings
+    message: str
+    symbol: str = ""       # enclosing function/class, "" at module level
+    suppressed: bool = False
+    suppression: str = ""  # "inline" | "baseline" | ""
+
+    def fingerprint(self) -> str:
+        """Line-number-independent identity for the baseline file:
+        unrelated edits above a finding must not invalidate its
+        baseline entry, so the line is excluded on purpose."""
+        ident = f"{self.code}|{self.path}|{self.symbol}|{self.message}"
+        return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": str(self.severity),
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "suppression": self.suppression,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Source files
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """A parsed python source plus the per-line suppression index."""
+
+    path: str          # as given (repo-relative when scanning the repo)
+    text: str
+    tree: ast.Module
+    _ignores: Dict[int, frozenset] = dataclasses.field(
+        default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str, rel_to: Optional[str] = None) -> "SourceFile":
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            text = fh.read()
+        rel = os.path.relpath(path, rel_to) if rel_to else path
+        tree = ast.parse(text, filename=rel)
+        src = cls(path=rel, text=text, tree=tree)
+        src._index_suppressions()
+        return src
+
+    _IGNORE_RE = re.compile(
+        r"#\s*galah-lint:\s*ignore\[([A-Z0-9,\s*]+)\]")
+
+    def _index_suppressions(self) -> None:
+        for lineno, line in enumerate(self.text.splitlines(), start=1):
+            m = self._IGNORE_RE.search(line)
+            if m:
+                codes = frozenset(
+                    c.strip() for c in m.group(1).split(",") if c.strip())
+                self._ignores[lineno] = codes
+
+    def is_ignored(self, code: str, line: int) -> bool:
+        """Inline suppression: a matching ignore comment on the flagged
+        line or the line directly above it (``*`` matches any code)."""
+        for ln in (line, line - 1):
+            codes = self._ignores.get(ln)
+            if codes and (code in codes or "*" in codes):
+                return True
+        return False
+
+
+def iter_python_files(root: str,
+                      subdirs: Sequence[str] = ("galah_tpu", "scripts",
+                                                "tests"),
+                      extra_files: Sequence[str] = ("bench.py",)) -> \
+        List[str]:
+    """Absolute paths of the repo's first-party python sources."""
+    out: List[str] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", "data")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    for fn in extra_files:
+        p = os.path.join(root, fn)
+        if os.path.isfile(p):
+            out.append(p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by checkers
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.experimental.pallas.pallas_call' for a Name/Attribute
+    chain, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def enclosing_functions(tree: ast.Module) -> Dict[ast.AST, ast.AST]:
+    """Map every node to its innermost enclosing FunctionDef (or None)."""
+    owner: Dict[ast.AST, ast.AST] = {}
+
+    def walk(node: ast.AST, fn: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            nfn = child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) else fn
+            owner[child] = fn
+            walk(child, nfn)
+
+    owner[tree] = None
+    walk(tree, None)
+    return owner
+
+
+SAFE_BINOPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.FloorDiv: lambda a, b: a // b,
+    ast.Div: lambda a, b: a / b,
+    ast.Mod: lambda a, b: a % b,
+    ast.Pow: lambda a, b: a ** b,
+}
+
+
+class SymbolicEvalError(Exception):
+    """A shape expression the restricted evaluator cannot resolve."""
+
+
+def safe_eval(node: ast.AST, env: Dict[str, object]):
+    """Evaluate a shape-arithmetic expression over `env` bindings.
+
+    Supports names, int/float/str constants, +-*//%** and unary ops,
+    tuples/lists, and negative ceil-division idioms (-(-a // b)).
+    Anything else raises SymbolicEvalError — callers downgrade that to
+    a 'could not evaluate statically' finding rather than guessing.
+    """
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise SymbolicEvalError(f"unbound name {node.id!r}")
+    if isinstance(node, ast.BinOp):
+        op = SAFE_BINOPS.get(type(node.op))
+        if op is None:
+            raise SymbolicEvalError(
+                f"unsupported operator {type(node.op).__name__}")
+        return op(safe_eval(node.left, env), safe_eval(node.right, env))
+    if isinstance(node, ast.UnaryOp):
+        v = safe_eval(node.operand, env)
+        if isinstance(node.op, ast.USub):
+            return -v
+        if isinstance(node.op, ast.UAdd):
+            return +v
+        raise SymbolicEvalError(
+            f"unsupported unary {type(node.op).__name__}")
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(safe_eval(e, env) for e in node.elts)
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("max", "min", "int", "abs") and not node.keywords:
+            fn = {"max": max, "min": min, "int": int, "abs": abs}[fname]
+            return fn(*(safe_eval(a, env) for a in node.args))
+        if fname == "math.gcd" and not node.keywords:
+            import math
+
+            return math.gcd(*(safe_eval(a, env) for a in node.args))
+        raise SymbolicEvalError(f"unsupported call {fname or '<expr>'}()")
+    raise SymbolicEvalError(
+        f"unsupported expression {type(node).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry from a committed baseline file (empty when
+    the file is absent)."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    entries = [
+        {
+            "fingerprint": f.fingerprint(),
+            "code": f.code,
+            "path": f.path,
+            "symbol": f.symbol,
+            "message": f.message,
+        }
+        for f in findings if not f.suppressed
+    ]
+    entries.sort(key=lambda e: (e["path"], e["code"], e["message"]))
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=1,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+def apply_suppressions(findings: List[Finding],
+                       sources: Dict[str, SourceFile],
+                       baseline: Dict[str, dict]) -> None:
+    """Mark findings covered by inline comments or the baseline."""
+    for f in findings:
+        src = sources.get(f.path)
+        if src is not None and f.line and src.is_ignored(f.code, f.line):
+            f.suppressed, f.suppression = True, "inline"
+        elif f.fingerprint() in baseline:
+            f.suppressed, f.suppression = True, "baseline"
+
+
+# ---------------------------------------------------------------------------
+# Reports
+# ---------------------------------------------------------------------------
+
+
+def render_human(findings: Sequence[Finding],
+                 show_suppressed: bool = False) -> str:
+    lines: List[str] = []
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    for f in sorted(shown, key=lambda f: (f.path, f.line, f.code)):
+        sup = f" (suppressed: {f.suppression})" if f.suppressed else ""
+        sym = f" [{f.symbol}]" if f.symbol else ""
+        lines.append(f"{f.path}:{f.line}: {f.severity} {f.code} "
+                     f"{f.message}{sym}{sup}")
+    active = [f for f in findings if not f.suppressed]
+    n_err = sum(1 for f in active if f.severity == Severity.ERROR)
+    n_warn = sum(1 for f in active if f.severity == Severity.WARNING)
+    n_info = sum(1 for f in active if f.severity == Severity.INFO)
+    n_sup = sum(1 for f in findings if f.suppressed)
+    lines.append(f"{n_err} error(s), {n_warn} warning(s), "
+                 f"{n_info} note(s), {n_sup} suppressed")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    active = [f for f in findings if not f.suppressed]
+    return json.dumps({
+        "version": 1,
+        "findings": [f.to_dict() for f in findings],
+        "summary": {
+            "errors": sum(1 for f in active
+                          if f.severity == Severity.ERROR),
+            "warnings": sum(1 for f in active
+                            if f.severity == Severity.WARNING),
+            "notes": sum(1 for f in active
+                         if f.severity == Severity.INFO),
+            "suppressed": sum(1 for f in findings if f.suppressed),
+        },
+    }, indent=1, sort_keys=True)
+
+
+def failing(findings: Sequence[Finding],
+            threshold: Severity = Severity.WARNING) -> List[Finding]:
+    """Unsuppressed findings at or above the failure threshold."""
+    return [f for f in findings
+            if not f.suppressed and f.severity >= threshold]

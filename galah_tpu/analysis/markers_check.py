@@ -1,0 +1,117 @@
+"""Hardware-test marker audit (GL6xx).
+
+Tests that only pass (or only mean anything) on real TPU hardware must
+never run in the default CPU tier-1 selection — the repo's convention
+is a ``slow`` or ``hardware`` pytest marker, which conftest.py
+default-skips. Two signals identify a hardware-only test module:
+
+  * its filename matches ``test_tpu_hw*`` (the live-hardware campaign
+    driver), or
+  * it imports ``galah_tpu.ops.pallas_sketch`` — the quarantined
+    Mosaic kernel whose parity tests need either interpret-mode
+    minutes or a real TPU.
+
+Every test function in such a module (including parametrized ones)
+must carry the marker, either per-test (``@pytest.mark.slow``) or
+module-wide (``pytestmark = pytest.mark.slow`` / a list containing
+it):
+
+  GL601  hardware-only test without a slow/hardware marker
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from galah_tpu.analysis.core import (Finding, Severity, SourceFile,
+                                     dotted_name)
+
+HW_MARKERS = {"slow", "hardware"}
+_QUARANTINED_MODULES = ("galah_tpu.ops.pallas_sketch",)
+
+
+def _marker_names(node: ast.AST) -> Set[str]:
+    """Marker names in a decorator / pytestmark expression: handles
+    pytest.mark.slow, pytest.mark.parametrize(...), and lists."""
+    names: Set[str] = set()
+    work = [node]
+    while work:
+        cur = work.pop()
+        if isinstance(cur, (ast.List, ast.Tuple)):
+            work.extend(cur.elts)
+        elif isinstance(cur, ast.Call):
+            work.append(cur.func)
+        elif isinstance(cur, ast.Attribute):
+            name = dotted_name(cur)
+            if name.startswith("pytest.mark.") or name.startswith(
+                    "mark."):
+                names.add(name.split(".")[-1])
+    return names
+
+
+def _module_markers(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            targets = [t.id for t in stmt.targets
+                       if isinstance(t, ast.Name)]
+            if "pytestmark" in targets:
+                names |= _marker_names(stmt.value)
+    return names
+
+
+def _imports_quarantined(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name in _QUARANTINED_MODULES
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module in _QUARANTINED_MODULES:
+                return True
+            # `from galah_tpu.ops import pallas_sketch`
+            full = {f"{node.module}.{a.name}" for a in node.names}
+            if full & set(_QUARANTINED_MODULES):
+                return True
+    return False
+
+
+def is_hardware_module(src: SourceFile) -> bool:
+    base = os.path.basename(src.path)
+    if base.startswith("test_tpu_hw"):
+        return True
+    return base.startswith("test_") and _imports_quarantined(src.tree)
+
+
+def check_markers_file(src: SourceFile,
+                       force_hardware: Optional[bool] = None) -> \
+        List[Finding]:
+    """GL601 over one test module. `force_hardware` overrides the
+    hardware-module heuristic (used by fixture tests)."""
+    hardware = (is_hardware_module(src) if force_hardware is None
+                else force_hardware)
+    if not hardware:
+        return []
+    findings: List[Finding] = []
+    module_marks = _module_markers(src.tree)
+    if module_marks & HW_MARKERS:
+        return []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if not node.name.startswith("test"):
+            continue
+        marks: Set[str] = set()
+        for dec in node.decorator_list:
+            marks |= _marker_names(dec)
+        if not marks & HW_MARKERS:
+            findings.append(Finding(
+                "GL601", Severity.ERROR, src.path, node.lineno,
+                f"hardware-only test {node.name!r} has no "
+                "slow/hardware marker — it would run (and hang or "
+                "fail) in the default CPU tier-1 selection",
+                node.name))
+    return findings

@@ -1,0 +1,324 @@
+"""Pallas contract checker (GL1xx): BlockSpec tiling, VMEM, 64-bit.
+
+For every ``pl.pallas_call`` site this checker statically evaluates the
+BlockSpec / scratch shape expressions — using the enclosing module's
+integer constants plus the representative bindings its
+``PALLAS_CONTRACT`` annotation declares — and verifies:
+
+  GL101  pallas_call site without a contract entry (or module without
+         a PALLAS_CONTRACT at all)
+  GL102  contract entry naming a function with no pallas_call (stale)
+  GL103  block last dim not a multiple of the 128-lane quantum
+  GL104  block sublane dim not a multiple of the dtype's quantum
+  GL105  estimated resident VMEM (in + out + scratch blocks) exceeds
+         the budget x safety factor
+  GL106  64-bit dtype at the kernel boundary or inside a kernel body
+         (TPU has no u64/i64/f64; this repo emulates via u32 planes)
+  GL107  a shape expression the restricted evaluator cannot resolve
+
+All checks run on CPU with zero compilation — the point is failing
+tier-1 before a TPU ever sees the code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from galah_tpu.analysis import contracts
+from galah_tpu.analysis.core import (Finding, Severity, SourceFile,
+                                     SymbolicEvalError, dotted_name,
+                                     enclosing_functions, safe_eval)
+
+
+def _is_call_to(node: ast.AST, suffix: str) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func).split(".")[-1] == suffix)
+
+
+def _keywords(call: ast.Call) -> Dict[str, ast.AST]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _local_assignments(fn: Optional[ast.AST]) -> Dict[str, ast.AST]:
+    """name -> value for simple ``name = expr`` statements in `fn`."""
+    out: Dict[str, ast.AST] = {}
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def _resolve(node: ast.AST, local: Dict[str, ast.AST],
+             depth: int = 0) -> ast.AST:
+    """Follow simple local ``spec = pl.BlockSpec(...)`` indirections."""
+    while isinstance(node, ast.Name) and node.id in local and depth < 5:
+        node = local[node.id]
+        depth += 1
+    return node
+
+
+def _flatten_spec_list(node: ast.AST, local: Dict[str, ast.AST],
+                       env: Dict[str, object]) -> List[ast.AST]:
+    """Elements of an in_specs/out_specs expression: handles list
+    literals, ``[spec] * 6`` replication, local-name indirection, a
+    bare single spec, and conditional expressions (both branches of an
+    IfExp are unioned — the checker must cover every variant)."""
+    node = _resolve(node, local)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out: List[ast.AST] = []
+        for elt in node.elts:
+            out.extend(_flatten_spec_list(elt, local, env))
+        return out
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        for seq, count in ((node.left, node.right),
+                           (node.right, node.left)):
+            if isinstance(_resolve(seq, local), (ast.List, ast.Tuple)):
+                try:
+                    n = int(safe_eval(count, env))
+                except SymbolicEvalError:
+                    n = 1
+                return _flatten_spec_list(seq, local, env) * n
+        return []
+    if isinstance(node, ast.IfExp):
+        return (_flatten_spec_list(node.body, local, env)
+                + _flatten_spec_list(node.orelse, local, env))
+    return [node]
+
+
+def _block_shape(spec: ast.Call, env: Dict[str, object]) -> \
+        Optional[Tuple[int, ...]]:
+    """The evaluated block shape of a BlockSpec / VMEM scratch call,
+    or None when the spec declares no shape (whole-array block)."""
+    shape_node: Optional[ast.AST] = None
+    if spec.args:
+        shape_node = spec.args[0]
+    else:
+        kw = _keywords(spec)
+        shape_node = kw.get("block_shape")
+    if shape_node is None or (isinstance(shape_node, ast.Constant)
+                              and shape_node.value is None):
+        return None
+    value = safe_eval(shape_node, env)
+    if not isinstance(value, tuple):
+        raise SymbolicEvalError("block shape is not a tuple")
+    return tuple(int(v) for v in value)
+
+
+def _check_block(shape: Tuple[int, ...], dtype: Optional[str],
+                 where: str, path: str, line: int, symbol: str,
+                 findings: List[Finding]) -> int:
+    """Tiling + dtype checks for one VMEM block; returns its bytes."""
+    dtype = dtype or "int32"
+    if dtype in contracts.BANNED_DTYPES:
+        findings.append(Finding(
+            "GL106", Severity.ERROR, path, line,
+            f"{where} uses {dtype}: TPU has no 64-bit unit — emulate "
+            "via hi/lo 32-bit planes (see ops/pallas_pairwise)",
+            symbol))
+    if len(shape) >= 1 and shape[-1] % contracts.LANE_QUANTUM:
+        findings.append(Finding(
+            "GL103", Severity.ERROR, path, line,
+            f"{where} block shape {shape}: last dim {shape[-1]} is not "
+            f"a multiple of the {contracts.LANE_QUANTUM}-lane quantum",
+            symbol))
+    if len(shape) >= 2:
+        q = contracts.sublane_quantum(dtype)
+        if shape[-2] % q:
+            findings.append(Finding(
+                "GL104", Severity.ERROR, path, line,
+                f"{where} block shape {shape}: sublane dim {shape[-2]} "
+                f"is not a multiple of the {dtype} quantum {q}",
+                symbol))
+    size = contracts.dtype_itemsize(dtype) or 4
+    n = 1
+    for d in shape:
+        n *= max(1, int(d))
+    return n * size
+
+
+def _scan_kernel_fns(tree: ast.Module, names: List[str], path: str,
+                     symbol: str, findings: List[Finding]) -> None:
+    """GL106 inside declared kernel-body functions: any reference to a
+    64-bit dtype in code that will lower through Mosaic."""
+    wanted = set(names)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in wanted:
+            for sub in ast.walk(node):
+                ref = None
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr in contracts.BANNED_DTYPES:
+                    ref = sub.attr
+                elif isinstance(sub, ast.Constant) \
+                        and sub.value in contracts.BANNED_DTYPES:
+                    ref = sub.value
+                if ref:
+                    findings.append(Finding(
+                        "GL106", Severity.ERROR, path, sub.lineno,
+                        f"kernel body {node.name}() references {ref}: "
+                        "no 64-bit unit on TPU", symbol or node.name))
+
+
+def check_pallas_file(src: SourceFile,
+                      contract: Optional[Dict[str, dict]] = None) -> \
+        List[Finding]:
+    """Run the GL1xx checks over one module."""
+    findings: List[Finding] = []
+    tree = src.tree
+    if contract is None:
+        contract = contracts.harvest_contract(tree)
+    consts = contracts.module_int_constants(tree)
+    owner = enclosing_functions(tree)
+
+    sites: List[Tuple[ast.Call, str]] = []
+    for node in ast.walk(tree):
+        if _is_call_to(node, "pallas_call"):
+            fn = owner.get(node)
+            sites.append((node, fn.name if fn is not None else ""))
+
+    if not sites:
+        if contract:
+            for name in contract:
+                findings.append(Finding(
+                    "GL102", Severity.ERROR, src.path, 1,
+                    f"PALLAS_CONTRACT entry {name!r} but the module "
+                    "has no pallas_call site", name))
+        return findings
+
+    if contract is None:
+        for call, symbol in sites:
+            findings.append(Finding(
+                "GL101", Severity.ERROR, src.path, call.lineno,
+                "pallas_call site without a PALLAS_CONTRACT "
+                "annotation (module-level dict literal; see "
+                "analysis/contracts.py)", symbol))
+        return findings
+
+    seen_fns = set()
+    for call, symbol in sites:
+        seen_fns.add(symbol)
+        entry = contract.get(symbol)
+        if entry is None:
+            findings.append(Finding(
+                "GL101", Severity.ERROR, src.path, call.lineno,
+                f"pallas_call in {symbol}() has no PALLAS_CONTRACT "
+                "entry", symbol))
+            continue
+        env: Dict[str, object] = dict(consts)
+        env.update(entry.get("bindings", {}))
+        budget = int(entry.get("vmem_budget_bytes",
+                               contracts.VMEM_BYTES))
+        safety = float(entry.get("vmem_safety",
+                                 contracts.VMEM_SAFETY_DEFAULT))
+        in_dtypes = list(entry.get("in_dtypes", []))
+        fn_node = next(
+            (n for n in ast.walk(tree)
+             if isinstance(n, ast.FunctionDef) and n.name == symbol),
+            None)
+        local = _local_assignments(fn_node)
+        kw = _keywords(call)
+
+        total_bytes = 0
+        unevaluated = False
+
+        def eval_specs(node: ast.AST, dtypes: List[Optional[str]],
+                       where: str) -> None:
+            nonlocal total_bytes, unevaluated
+            specs = _flatten_spec_list(node, local, env)
+            for i, spec_node in enumerate(specs):
+                spec_node = _resolve(spec_node, local)
+                if not isinstance(spec_node, ast.Call):
+                    continue
+                dtype = dtypes[i] if i < len(dtypes) else None
+                try:
+                    shape = _block_shape(spec_node, env)
+                except SymbolicEvalError as e:
+                    unevaluated = True
+                    findings.append(Finding(
+                        "GL107", Severity.WARNING, src.path,
+                        spec_node.lineno,
+                        f"{where}[{i}] block shape not statically "
+                        f"evaluable ({e}); add the missing symbol to "
+                        "the contract's bindings", symbol))
+                    continue
+                if shape is None:
+                    continue
+                total_bytes += _check_block(
+                    shape, dtype, f"{where}[{i}]", src.path,
+                    spec_node.lineno, symbol, findings)
+
+        # out dtypes come from the out_shape ShapeDtypeStructs
+        out_dtypes: List[Optional[str]] = []
+        out_shape_node = kw.get("out_shape")
+        if out_shape_node is not None:
+            resolved = _resolve(out_shape_node, local)
+            elts = (resolved.elts
+                    if isinstance(resolved, (ast.List, ast.Tuple))
+                    else [resolved])
+            for elt in elts:
+                elt = _resolve(elt, local)
+                if isinstance(elt, ast.Call) and len(elt.args) >= 2:
+                    out_dtypes.append(
+                        contracts.dtype_from_node(elt.args[1]))
+                else:
+                    out_dtypes.append(None)
+
+        if "in_specs" in kw:
+            eval_specs(kw["in_specs"], in_dtypes, "in_specs")
+        if "out_specs" in kw:
+            eval_specs(kw["out_specs"], out_dtypes, "out_specs")
+
+        # banned dtypes in out_shape even when out_specs are shapeless
+        for i, d in enumerate(out_dtypes):
+            if d in contracts.BANNED_DTYPES:
+                findings.append(Finding(
+                    "GL106", Severity.ERROR, src.path, call.lineno,
+                    f"out_shape[{i}] declares {d}: TPU has no 64-bit "
+                    "unit", symbol))
+
+        # scratch: pltpu.VMEM((shape), dtype) entries
+        scratch_node = kw.get("scratch_shapes")
+        if scratch_node is not None:
+            for i, s in enumerate(_flatten_spec_list(
+                    scratch_node, local, env)):
+                s = _resolve(s, local)
+                if not (isinstance(s, ast.Call)
+                        and dotted_name(s.func).endswith("VMEM")):
+                    continue
+                dtype = (contracts.dtype_from_node(s.args[1])
+                         if len(s.args) >= 2 else None)
+                try:
+                    shape = _block_shape(s, env)
+                except SymbolicEvalError as e:
+                    unevaluated = True
+                    findings.append(Finding(
+                        "GL107", Severity.WARNING, src.path, s.lineno,
+                        f"scratch_shapes[{i}] not statically evaluable "
+                        f"({e})", symbol))
+                    continue
+                if shape is not None:
+                    total_bytes += _check_block(
+                        shape, dtype, f"scratch_shapes[{i}]", src.path,
+                        s.lineno, symbol, findings)
+
+        limit = int(budget * safety)
+        if not unevaluated and total_bytes > limit:
+            findings.append(Finding(
+                "GL105", Severity.ERROR, src.path, call.lineno,
+                f"estimated resident VMEM {total_bytes} B exceeds "
+                f"budget {budget} B x safety {safety} = {limit} B at "
+                "the contract's representative bindings", symbol))
+
+        _scan_kernel_fns(tree, list(entry.get("kernel_fns", [])),
+                         src.path, symbol, findings)
+
+    for name in contract:
+        if name not in seen_fns:
+            findings.append(Finding(
+                "GL102", Severity.ERROR, src.path, 1,
+                f"PALLAS_CONTRACT entry {name!r} names a function "
+                "with no pallas_call site (stale contract)", name))
+    return findings

@@ -1,0 +1,211 @@
+"""Host-sync / tracer-leak (GL2xx) and recompile-churn (GL3xx) checks.
+
+Both walk functions decorated with ``@jax.jit`` (bare, ``@jit``, or
+through ``functools.partial(jax.jit, ...)``) — the only places where
+host/device sync bugs and trace-time captures hide:
+
+  GL201  ``.item()`` / ``float()/int()/bool()`` on a traced argument —
+         forces a device sync (or a TracerConversionError at trace
+         time); hoist out of the jitted body
+  GL202  ``np.asarray``/``np.array`` on a traced argument — silently
+         pulls the value to host
+  GL203  Python ``if``/``while`` on a traced argument — control flow
+         must be ``lax.cond``/``lax.while_loop`` or the argument made
+         static (``.shape``/``.dtype``/``.ndim``/``.size`` accesses
+         are static and exempt)
+  GL301  ``os.environ``/``os.getenv`` read inside a jitted body — the
+         value is frozen at trace time: later env changes are silently
+         ignored (and a hashable-captured read forces recompiles when
+         it varies); resolve flags OUTSIDE the jit boundary, as
+         ops/pallas_pairlist.pairlist_block_pairs does
+  GL302  a static argument whose default is an unhashable literal
+         (list/dict/set) — every call raises or recompiles
+
+The detectors deliberately key on *direct parameter names*: values
+derived from parameters would need dataflow analysis and, in this
+codebase's idiom (shape unpacking before any branching), direct use is
+exactly the bug signature.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from galah_tpu.analysis.core import (Finding, Severity, SourceFile,
+                                     dotted_name)
+
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "at"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_NP_PULLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+             "onp.asarray", "onp.array"}
+
+
+def _jit_decoration(fn: ast.FunctionDef) -> Optional[ast.AST]:
+    """The jax.jit decorator node when `fn` is jitted, else None."""
+    for dec in fn.decorator_list:
+        name = dotted_name(dec)
+        if name in ("jax.jit", "jit"):
+            return dec
+        if isinstance(dec, ast.Call):
+            cname = dotted_name(dec.func)
+            if cname in ("jax.jit", "jit"):
+                return dec
+            if cname in ("functools.partial", "partial") and dec.args \
+                    and dotted_name(dec.args[0]) in ("jax.jit", "jit"):
+                return dec
+    return None
+
+
+def _static_names(fn: ast.FunctionDef,
+                  dec: ast.AST) -> Tuple[Set[str], Set[int]]:
+    """Parameter names/positions declared static on the jit decorator."""
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    if isinstance(dec, ast.Call):
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                try:
+                    v = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    continue
+                if isinstance(v, str):
+                    names.add(v)
+                else:
+                    names.update(v)
+            elif kw.arg == "static_argnums":
+                try:
+                    v = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    continue
+                if isinstance(v, int):
+                    nums.add(v)
+                else:
+                    nums.update(v)
+    return names, nums
+
+
+def _traced_params(fn: ast.FunctionDef, dec: ast.AST) -> Set[str]:
+    static_names, static_nums = _static_names(fn, dec)
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    traced = set()
+    for i, p in enumerate(params):
+        if p in static_names or i in static_nums or p == "self":
+            continue
+        traced.add(p)
+    traced.update(a.arg for a in fn.args.kwonlyargs
+                  if a.arg not in static_names)
+    return traced
+
+
+def _exempt_name_nodes(expr: ast.AST) -> Set[int]:
+    """ids of Name nodes under a static attribute access (x.shape[0]
+    is trace-static even when x is traced)."""
+    exempt: Set[int] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in _STATIC_ATTRS:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    exempt.add(id(sub))
+    return exempt
+
+
+def _traced_name_in(expr: ast.AST, traced: Set[str]) -> Optional[str]:
+    exempt = _exempt_name_nodes(expr)
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in traced \
+                and id(node) not in exempt:
+            return node.id
+    return None
+
+
+def _unhashable_static_defaults(fn: ast.FunctionDef, dec: ast.AST,
+                                path: str,
+                                findings: List[Finding]) -> None:
+    static_names, static_nums = _static_names(fn, dec)
+    args = fn.args.posonlyargs + fn.args.args
+    defaults = fn.args.defaults
+    offset = len(args) - len(defaults)
+    for i, default in enumerate(defaults):
+        arg = args[offset + i]
+        if (arg.arg in static_names or (offset + i) in static_nums) \
+                and isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            findings.append(Finding(
+                "GL302", Severity.ERROR, path, default.lineno,
+                f"static arg {arg.arg!r} defaults to an unhashable "
+                f"{type(default).__name__.lower()} literal — jit "
+                "hashes static args; use a tuple or None",
+                fn.name))
+
+
+def check_runtime_file(src: SourceFile) -> List[Finding]:
+    """GL2xx/GL3xx over one module."""
+    findings: List[Finding] = []
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        dec = _jit_decoration(fn)
+        if dec is None:
+            continue
+        traced = _traced_params(fn, dec)
+        _unhashable_static_defaults(fn, dec, src.path, findings)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                cname = dotted_name(node.func)
+                # .item() on anything inside a jit body
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    findings.append(Finding(
+                        "GL201", Severity.ERROR, src.path, node.lineno,
+                        ".item() inside a jitted body forces a host "
+                        "sync (TracerConversionError at trace time); "
+                        "return the array and convert outside jit",
+                        fn.name))
+                elif cname in _HOST_CASTS and len(node.args) == 1 \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in traced:
+                    findings.append(Finding(
+                        "GL201", Severity.ERROR, src.path, node.lineno,
+                        f"{cname}() on traced argument "
+                        f"{node.args[0].id!r} inside a jitted body — "
+                        "host conversion of a tracer", fn.name))
+                elif cname in _NP_PULLS and node.args:
+                    leak = _traced_name_in(node.args[0], traced)
+                    if leak:
+                        findings.append(Finding(
+                            "GL202", Severity.WARNING, src.path,
+                            node.lineno,
+                            f"{cname}() on traced argument {leak!r} "
+                            "inside a jitted body pulls the value to "
+                            "host; use jnp instead", fn.name))
+                elif cname in ("os.environ.get", "os.getenv",
+                               "environ.get") \
+                        or dotted_name(node.func).startswith(
+                            "os.environ."):
+                    findings.append(Finding(
+                        "GL301", Severity.ERROR, src.path, node.lineno,
+                        "environment read inside a jitted body is "
+                        "frozen at trace time (silent staleness / "
+                        "recompile churn); resolve the flag outside "
+                        "the jit boundary", fn.name))
+            elif isinstance(node, ast.Subscript) \
+                    and dotted_name(node.value) == "os.environ":
+                findings.append(Finding(
+                    "GL301", Severity.ERROR, src.path, node.lineno,
+                    "os.environ[...] inside a jitted body is frozen "
+                    "at trace time; resolve the flag outside the jit "
+                    "boundary", fn.name))
+            elif isinstance(node, (ast.If, ast.While)):
+                leak = _traced_name_in(node.test, traced)
+                if leak:
+                    kind = ("if" if isinstance(node, ast.If)
+                            else "while")
+                    findings.append(Finding(
+                        "GL203", Severity.WARNING, src.path,
+                        node.lineno,
+                        f"python {kind} on traced argument {leak!r} "
+                        "inside a jitted body — use lax.cond/"
+                        "while_loop or declare the argument static",
+                        fn.name))
+    return findings

@@ -154,8 +154,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="Directory for the persistent sketch cache "
                          "(also via GALAH_TPU_CACHE)")
     dd.add_argument("--threads", "-t", type=int, default=1)
+
+    li = sub.add_parser(
+        "lint",
+        help="Static analysis of the codebase: Pallas kernel "
+             "contracts, tracer leaks, flag registry, shape contracts",
+        description="Run the galah-tpu static-analysis suite "
+                    "(equivalent to `python -m galah_tpu.analysis`); "
+                    "exits 1 on any unsuppressed finding at WARNING "
+                    "or above")
+    from galah_tpu.analysis import add_lint_arguments
+
+    add_lint_arguments(li)
     parser._subcommand_parsers = {"cluster": c, "cluster-validate": v,
-                                  "dist": dd}
+                                  "dist": dd, "lint": li}
     return parser
 
 
@@ -400,6 +412,15 @@ def main(argv=None) -> int:
         print_full_help(parser._subcommand_parsers[args.subcommand],
                         args.subcommand)
         return 0
+    if args.subcommand == "lint":
+        # CPU is all the lint needs (the shape harness only abstract-
+        # evals); x64 keeps the uint64 ops tracing with real dtypes.
+        # Both must land before any jax import.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault("JAX_ENABLE_X64", "1")
+        from galah_tpu.analysis import main as lint_main
+
+        return lint_main(args=args)
     set_log_level(verbose=getattr(args, "verbose", False),
                   quiet=getattr(args, "quiet", False))
     platform = (getattr(args, "platform", None)

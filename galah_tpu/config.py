@@ -9,7 +9,8 @@ reference hard-codes (reference: src/finch.rs:33-45, src/skani.rs:131-163).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+import os
+from typing import Dict, Optional, Sequence, Tuple
 
 
 class Defaults:
@@ -47,6 +48,163 @@ class Defaults:
     # Quality-filter defaults: no filtering unless quality input given
     MIN_COMPLETENESS = None
     MAX_CONTAMINATION = None
+
+
+# ---------------------------------------------------------------------------
+# GALAH_* environment-flag registry
+#
+# Every environment variable the project reads is declared here, once,
+# with its default and one-line documentation. The registry is the
+# single source of truth three consumers share:
+#   * call sites — read through ``env_value(name)`` (or keep a local
+#     ``os.environ`` read, which the lint cross-checks against this
+#     table);
+#   * ``manpage.py`` — auto-renders the ENVIRONMENT section of every
+#     --full-help page from this table (no hand-maintained list);
+#   * ``galah_tpu.analysis`` — the flag checker AST-enumerates every
+#     GALAH_* read in the tree and fails on flags missing from this
+#     table or carrying a conflicting literal default at the read site.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    """One registered environment variable."""
+
+    name: str                       # full env var name, GALAH_*
+    help: str                       # one-line doc (manpage ENVIRONMENT)
+    default: Optional[str] = None   # None == unset; always the string form
+    kind: str = "str"               # str | int | float | bool | grammar
+    section: str = "runtime"        # runtime|kernel|resilience|bench|test|scripts
+    choices: Tuple[str, ...] = ()
+    # Where the read happens outside the python tree the linter scans
+    # (C sources, shell scripts) — suppresses the unread-flag notice.
+    external_reader: Optional[str] = None
+
+
+def _retry_family(prefix: str, section_help: str) -> Tuple[Flag, ...]:
+    """The seven knobs RetryPolicy.from_env reads under `prefix`_*."""
+    spec = (
+        ("MAX_ATTEMPTS", "int", "attempts per dispatch before giving up"),
+        ("BASE_DELAY", "float", "first backoff delay, seconds"),
+        ("MAX_DELAY", "float", "backoff cap, seconds"),
+        ("JITTER", "float", "+- fraction of each delay, in [0, 1]"),
+        ("ATTEMPT_DEADLINE", "float",
+         "seconds per attempt; a wedged attempt is abandoned"),
+        ("TOTAL_BUDGET", "float",
+         "overall retry wall-clock budget per call, seconds"),
+        ("SEED", "int", "makes the backoff jitter bit-reproducible"),
+    )
+    return tuple(
+        Flag(name=f"{prefix}_{suffix}", kind=kind, section="resilience",
+             help=f"{section_help}: {doc}",
+             external_reader="resilience/policy.py RetryPolicy.from_env "
+                             "(dynamic f-string read)")
+        for suffix, kind, doc in spec)
+
+
+_FLAG_DEFS: Tuple[Flag, ...] = (
+    # -- runtime / IO ------------------------------------------------------
+    Flag("GALAH_TPU_PLATFORM", section="runtime",
+         help="Force the JAX platform (cpu, tpu, ...); the --platform "
+              "flag's env twin and loses to it"),
+    Flag("GALAH_TPU_CACHE", section="runtime",
+         help="Directory for the persistent sketch/profile cache; the "
+              "--sketch-cache flag's env twin and loses to it. Unset "
+              "disables caching"),
+    # -- kernel / device policy -------------------------------------------
+    Flag("GALAH_TPU_DENSE_PAIRS", kind="bool", section="kernel",
+         help="Force the dense O(N^2) pairwise pass (skip the sparse "
+              "collision screen) regardless of problem size"),
+    Flag("GALAH_TPU_SPARSE_MIN_N", kind="int", default="1024",
+         section="kernel",
+         help="Genome count at which the sparse collision screen "
+              "replaces dense all-pairs passes; malformed values are "
+              "logged and ignored"),
+    Flag("GALAH_TPU_PAIR_BATCH", kind="int", section="kernel",
+         help="Candidate pairs per device dispatch of the screened "
+              "pipeline; unset picks 8192 (CPU) or 32768 (TPU)"),
+    Flag("GALAH_TPU_PAIRLIST_STRATEGY", section="kernel",
+         choices=("blocked", "gather", "xla", "cpu"),
+         help="Pin the survivor-evaluation strategy instead of the "
+              "AUTO heuristic"),
+    Flag("GALAH_TPU_PAIRLIST_BLOCK", kind="int", default="8",
+         section="kernel",
+         help="Pairs per program (P) for the blocked Mosaic pairlist "
+              "kernel"),
+    Flag("GALAH_TPU_PALLAS_HASH", kind="bool", section="kernel",
+         help="1 forces the quarantined Mosaic murmur3 kernel, 0 "
+              "forces the XLA u64 emulation; unset uses the "
+              "data-driven per-backend default"),
+    Flag("GALAH_PACKED_TRANSFER", kind="bool", section="kernel",
+         help="Force (1) or forbid (0) the packed-upload / batched "
+              "transfer policy; unset defers to the backend probe"),
+    Flag("GALAH_TPU_NO_CCOLLISION", kind="bool", section="kernel",
+         help="Disable the C collision-counting fast path (numpy "
+              "fallback)",
+         external_reader="utils/cbuild.py (disable_env)"),
+    Flag("GALAH_TPU_NO_CPAIRSTATS", kind="bool", section="kernel",
+         help="Disable the C pair-stats fast path",
+         external_reader="utils/cbuild.py (disable_env)"),
+    Flag("GALAH_TPU_NO_CSKETCH", kind="bool", section="kernel",
+         help="Disable the C sketch fast path",
+         external_reader="utils/cbuild.py (disable_env)"),
+    Flag("GALAH_TPU_NO_CINGEST", kind="bool", section="kernel",
+         help="Disable the C FASTA-ingest fast path",
+         external_reader="utils/cbuild.py (disable_env)"),
+    Flag("GALAH_TPU_NO_AVX512", kind="bool", section="kernel",
+         help="Keep the C merge counter off its AVX-512 kernel",
+         external_reader="csrc/pairstats.c (getenv)"),
+    # -- resilience --------------------------------------------------------
+    Flag("GALAH_FI", kind="grammar", section="resilience",
+         help="Deterministic fault injection, e.g. "
+              "'site=dispatch.ani;kind=raise;prob=0.3;seed=7;max=2' "
+              "(docs/resilience.md)"),
+) + _retry_family(
+    "GALAH_RETRY", "Device-dispatch retry policy"
+) + _retry_family(
+    "GALAH_IO_RETRY", "FASTA/IO retry policy (defaults: 3 attempts, "
+    "0.1 s base delay)"
+) + (
+    # -- bench / test / scripts -------------------------------------------
+    Flag("GALAH_BENCH_STAGE_CAP", kind="float", default="3000",
+         section="bench",
+         help="Per-stage wall-clock cap for bench.py, seconds; the "
+              "TPU watcher derives it from BENCH_TIMEOUT"),
+    Flag("GALAH_BENCH_N", kind="int", section="bench",
+         help="Override the genome count of the bench.py ladder stage"),
+    Flag("GALAH_RUN_SLOW", kind="bool", section="test",
+         help="1 runs the slow/hardware test tier the default run "
+              "skips"),
+    Flag("GALAH_RUN_CAMPAIGN", kind="bool", section="test",
+         help="1 runs the full abisko18 campaign combo matrix"),
+    Flag("GALAH_TPU_TUNNEL_LOCK", section="scripts",
+         default="/tmp/galah_tpu_tunnel.lock",
+         help="Lock file serializing TPU tunnel clients (validation "
+              "watcher)",
+         external_reader="scripts/tpu_validation_run.sh"),
+    Flag("GALAH_TUNNEL_LOCKED", section="scripts",
+         help="Internal: set by the validation watcher once it holds "
+              "the tunnel lock, to short-circuit the re-exec",
+         external_reader="scripts/tpu_validation_run.sh"),
+)
+
+FLAGS: Dict[str, Flag] = {f.name: f for f in _FLAG_DEFS}
+
+#: Dynamic-prefix families (read via f-strings, e.g. RetryPolicy.from_env).
+FLAG_FAMILIES: Tuple[str, ...] = ("GALAH_RETRY", "GALAH_IO_RETRY")
+
+
+def env_value(name: str) -> Optional[str]:
+    """The registered flag's current value: the environment when set,
+    else the registry default (None for unset). Reading an unregistered
+    name raises — new flags must be declared in FLAGS first."""
+    flag = FLAGS.get(name)
+    if flag is None:
+        raise KeyError(f"environment flag {name} is not registered in "
+                       "galah_tpu.config.FLAGS")
+    raw = os.environ.get(name)
+    return raw if raw not in (None, "") else flag.default
 
 
 PRECLUSTER_METHODS = ("skani", "finch", "dashing")

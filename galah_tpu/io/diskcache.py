@@ -34,12 +34,14 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-_ENV_VAR = "GALAH_TPU_CACHE"
-
 
 def default_cache_dir() -> Optional[str]:
-    """Cache directory from the environment, or None (disabled)."""
-    return os.environ.get(_ENV_VAR) or None
+    """Cache directory from the GALAH_TPU_CACHE flag, or None
+    (disabled). The flag's name and default live once, in the
+    config.FLAGS registry — not here and not in cli.py."""
+    from galah_tpu.config import env_value
+
+    return env_value("GALAH_TPU_CACHE") or None
 
 
 class CacheDir:

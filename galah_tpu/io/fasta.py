@@ -116,8 +116,11 @@ def _io_policy():
     if _IO_POLICY is None:
         from galah_tpu.resilience.policy import RetryPolicy
 
-        _IO_POLICY = RetryPolicy.from_env("GALAH_IO_RETRY",
-                                          max_attempts=3, base_delay=0.1)
+        # defaults= (not keyword overrides) so the GALAH_IO_RETRY_*
+        # env knobs actually win over the IO-specific baseline
+        _IO_POLICY = RetryPolicy.from_env(
+            "GALAH_IO_RETRY",
+            defaults=dict(max_attempts=3, base_delay=0.1))
     return _IO_POLICY
 
 

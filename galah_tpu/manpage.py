@@ -126,6 +126,57 @@ EXAMPLES
 }
 
 
+_ENV_SECTION_TITLES = [
+    ("runtime", "Runtime and IO"),
+    ("kernel", "Kernel and device policy"),
+    ("resilience", "Resilience"),
+    ("bench", "Benchmarks"),
+    ("test", "Test selection"),
+    ("scripts", "Scripts"),
+]
+
+
+def render_environment_section() -> str:
+    """The ENVIRONMENT section, auto-rendered from the central
+    GALAH_* registry (config.FLAGS) so the manpage can never drift
+    from the code — `galah-tpu lint` (GL405) asserts every registered
+    flag appears here."""
+    from galah_tpu.config import FLAGS
+
+    out = ["ENVIRONMENT",
+           _wrap("Every GALAH_* variable the project reads, from the "
+                 "central registry in galah_tpu.config.FLAGS."),
+           ""]
+    by_section = {}
+    for flag in FLAGS.values():
+        by_section.setdefault(flag.section, []).append(flag)
+    for section, title in _ENV_SECTION_TITLES:
+        flags = sorted(by_section.pop(section, []),
+                       key=lambda f: f.name)
+        if not flags:
+            continue
+        out.append(f"  {title}:")
+        for flag in flags:
+            head = f"  {flag.name}"
+            if flag.default is not None:
+                head += f" (default: {flag.default})"
+            out.append(head)
+            help_text = flag.help
+            if flag.choices:
+                help_text += f" [choices: {', '.join(flag.choices)}]"
+            out.append(_wrap(help_text, indent=6))
+        out.append("")
+    # a section key unknown to the titles table must still render —
+    # flags can never silently vanish from the page
+    for section in sorted(by_section):
+        out.append(f"  {section}:")
+        for flag in sorted(by_section[section], key=lambda f: f.name):
+            out.append(f"  {flag.name}")
+            out.append(_wrap(flag.help, indent=6))
+        out.append("")
+    return "\n".join(out)
+
+
 def render_full_help(parser: argparse.ArgumentParser,
                      subcommand: str) -> str:
     by_flag = {}
@@ -166,6 +217,7 @@ def render_full_help(parser: argparse.ArgumentParser,
             out.append(_format_action(by_flag[f]))
         out.append("")
 
+    out.append(render_environment_section())
     out.append(_EPILOGS.get(subcommand, ""))
     return "\n".join(out)
 
@@ -230,6 +282,20 @@ def render_full_help_roff(parser: argparse.ArgumentParser,
         out.append(".SH OTHER GENERAL OPTIONS")
         for f in rest:
             emit_action(by_flag[f])
+    from galah_tpu.config import FLAGS
+
+    out.append(".SH ENVIRONMENT")
+    for flag in sorted(FLAGS.values(), key=lambda f: f.name):
+        out.append(".TP")
+        head = f"\\fB{esc(flag.name)}\\fR"
+        if flag.default is not None:
+            head += f" (default: {esc(flag.default)})"
+        out.append(head)
+        help_text = flag.help
+        if flag.choices:
+            help_text += f" [choices: {', '.join(flag.choices)}]"
+        out.append(esc(help_text))
+
     epilog = _EPILOGS.get(subcommand, "")
     for block in epilog.split("\n\n"):
         if not block.strip():

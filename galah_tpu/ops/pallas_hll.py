@@ -27,6 +27,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+# Static kernel contract checked by `galah-tpu lint` (GL1xx):
+# representative bindings at the largest tile the row-block driver
+# feeds this kernel (512x512 tile, m=4096 registers, chunk=1024).
+PALLAS_CONTRACT = {
+    "hll_union_stats_tile": {
+        "bindings": {"br": 512, "bc": 512, "chunk": 1024},
+        "in_dtypes": ["float32", "float32"],
+        "kernel_fns": ["_kernel"],
+    },
+}
+
+
 def _kernel(rows_ref, cols_ref, powsum_ref, zeros_ref):
     # Grid (m/chunk,): step c reduces the c-th register chunk of every
     # row sketch against every column sketch, accumulating into the

@@ -82,6 +82,18 @@ B_LANE = 128
 # across the block.
 PAIRLIST_BLOCK_DEFAULT = 8
 
+# Static kernel contract checked by `galah-tpu lint` (GL1xx):
+# representative bindings at the default block (bp=8) and k_pad=1024,
+# so la = k_pad/A_SUB and sb = k_pad/B_LANE.
+PALLAS_CONTRACT = {
+    "_pair_stats_pairs_jit": {
+        "bindings": {"bp": 8, "la": 128, "sb": 8},
+        "in_dtypes": ["uint32", "uint32", "uint32", "uint32"],
+        "kernel_fns": ["_make_blocked_kernel", "_make_kernel",
+                       "_pair_body"],
+    },
+}
+
 
 def pairlist_block_pairs() -> int:
     """P for the blocked pairlist kernel (GALAH_TPU_PAIRLIST_BLOCK to

@@ -45,6 +45,19 @@ A_SUB = 8     # a-chunk height: consecutive sketch values per lane column
 B_LANE = 128  # b-chunk width: consecutive sketch values per sublane row
 ROWS_PER_PROGRAM = 8
 
+# Static kernel contract checked by `galah-tpu lint` (GL1xx): bindings
+# are representative *maximum* values of the call-site locals the
+# BlockSpec shapes reference — k_pad=1024 (la = k_pad/A_SUB,
+# sb = k_pad/B_LANE) and bc at its 4 MiB reference-side chunk limit.
+PALLAS_CONTRACT = {
+    "tile_stats_pallas": {
+        "bindings": {"rp": 8, "la": 128, "sb": 8, "bc": 512},
+        "in_dtypes": ["uint32", "uint32", "uint32", "uint32"],
+        "kernel_fns": ["_make_kernel", "_pairmin", "_pairmax",
+                       "_col_reduce", "_ssum_i32"],
+    },
+}
+
 
 def _inclusive_cumsum_axis0(x: jax.Array) -> jax.Array:
     """Hillis-Steele prefix sum along sublanes via static shifts."""

@@ -46,6 +46,19 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 BLOCK_SUB = 512  # sublanes per grid program (block = BLOCK_SUB x 128)
 
+# Static kernel contract checked by `galah-tpu lint` (GL1xx): every
+# block shape is (BLOCK_SUB, LANES) u32 planes, so no call-site
+# bindings are needed.
+PALLAS_CONTRACT = {
+    "murmur3_k21_pallas": {
+        "bindings": {},
+        "in_dtypes": ["uint32", "uint32", "uint32",
+                      "uint32", "uint32", "uint32"],
+        "kernel_fns": ["_make_kernel", "_mulc64", "_add64", "_addc64",
+                       "_xorc64", "_rotl64", "_shr64_xor", "_fmix64"],
+    },
+}
+
 _C1 = 0x87C37B91114253D5
 _C2 = 0x4CF5AD432745937F
 _F1 = 0xFF51AFD7ED558CCD

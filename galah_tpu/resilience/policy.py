@@ -98,10 +98,14 @@ class RetryPolicy:
 
     @classmethod
     def from_env(cls, prefix: str = "GALAH_RETRY",
+                 defaults: Optional[dict] = None,
                  **overrides) -> "RetryPolicy":
         """Policy with env-var overrides: <prefix>_MAX_ATTEMPTS,
         _BASE_DELAY, _MAX_DELAY, _JITTER, _ATTEMPT_DEADLINE,
-        _TOTAL_BUDGET, _SEED. Explicit keyword overrides win over env."""
+        _TOTAL_BUDGET, _SEED. `defaults` seeds values the env may
+        override (a caller's site-specific baseline, e.g. the IO
+        policy's 0.1 s base delay); explicit keyword overrides win
+        over both."""
         spec = {
             "max_attempts": int,
             "base_delay": float,
@@ -111,7 +115,7 @@ class RetryPolicy:
             "total_budget": float,
             "seed": int,
         }
-        kwargs = {}
+        kwargs = dict(defaults or {})
         for name, conv in spec.items():
             raw = os.environ.get(f"{prefix}_{name.upper()}")
             if raw is not None and raw != "":

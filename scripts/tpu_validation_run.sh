@@ -58,6 +58,10 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
 # the external `timeout` would SIGKILL it mid-write (the round-5
 # captures that exited 124 with no data died exactly that way).
 BENCH_TIMEOUT=3000
+# Cheap static gate first: kernel contracts, tracer leaks, flag
+# registry, shape snapshots — seconds on the host VM, and a failure
+# here means the expensive hardware stages would exercise broken code.
+run_stage lint 300 python -u -m galah_tpu.analysis --json
 run_stage test_tpu_hw 2400 env GALAH_RUN_SLOW=1 \
   python -u -m pytest tests/test_tpu_hw.py -q
 run_stage amortized 1800 python -u scripts/bench_amortized.py

@@ -35,6 +35,11 @@ def pytest_configure(config):
         "GALAH_RUN_SLOW=1 (or GALAH_RUN_CAMPAIGN=1, or -m slow)")
     config.addinivalue_line(
         "markers",
+        "hardware: tests that require a real TPU — always skipped on "
+        "CPU; `galah-tpu lint` (GL601) audits that every "
+        "hardware-only test carries this or the slow marker")
+    config.addinivalue_line(
+        "markers",
         "fault_injection: seeded fault-injection tests of the "
         "resilience layer (retry/demote/quarantine) — fast, CPU-only, "
         "part of the default tier-1 run; select just them with "
@@ -54,7 +59,7 @@ def pytest_collection_modifyitems(config, items):
     skip = pytest.mark.skip(
         reason="slow tier; set GALAH_RUN_SLOW=1 to run")
     for item in items:
-        if "slow" in item.keywords:
+        if "slow" in item.keywords or "hardware" in item.keywords:
             item.add_marker(skip)
 
 

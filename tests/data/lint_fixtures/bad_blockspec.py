@@ -1,0 +1,34 @@
+"""Seeded GL103/GL104 violations: off-quantum BlockSpec dims."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PALLAS_CONTRACT = {
+    "bad_tile": {
+        "bindings": {"rows": 16},
+        "in_dtypes": ["float32"],
+        "kernel_fns": ["_k"],
+    },
+}
+
+
+def _k(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def bad_tile(x):
+    return pl.pallas_call(
+        _k,
+        grid=(1,),
+        in_specs=[
+            # lane dim 100 is not a multiple of 128 -> GL103,
+            # sublane dim 7 is not a multiple of the f32 quantum -> GL104
+            pl.BlockSpec((7, 100), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),  # noqa: F821
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+    )(x)

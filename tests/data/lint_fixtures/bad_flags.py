@@ -1,0 +1,12 @@
+"""Seeded GL401/GL402 violations: flag registry drift."""
+
+import os
+
+# GL401: flag that skipped the central registry
+typo = os.environ.get("GALAH_TPU_CAHCE")
+
+# GL402: literal default conflicting with the registry's "8"
+block = int(os.environ.get("GALAH_TPU_PAIRLIST_BLOCK", "16"))
+
+# negative control: matching literal default is fine
+sparse = int(os.environ.get("GALAH_TPU_SPARSE_MIN_N", "1024"))

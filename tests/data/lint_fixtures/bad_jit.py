@@ -1,0 +1,40 @@
+"""Seeded GL2xx/GL3xx violations inside jitted bodies."""
+
+import functools
+import os
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def host_syncs(x):
+    # GL203: python control flow on a tracer
+    if x:
+        # GL201: host cast of a tracer
+        return float(x)
+    # GL202: silent device->host pull
+    y = np.asarray(x)
+    # GL201: .item() inside a jitted body
+    return y, x.item()
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def env_in_jit(x, mode="fast"):
+    # GL301: environment read frozen at trace time
+    flag = os.environ.get("GALAH_TPU_DENSE_PAIRS", "")
+    return x if flag else -x
+
+
+# GL302: unhashable default on a static argument
+@functools.partial(jax.jit, static_argnames=("opts",))
+def unhashable_static(x, opts=[1, 2]):
+    return x
+
+
+@jax.jit
+def clean_shapes(x):
+    # negative control: .shape access on a tracer is static and exempt
+    if x.shape[0] > 4:
+        return x[:4]
+    return x

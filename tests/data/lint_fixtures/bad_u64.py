@@ -1,0 +1,33 @@
+"""Seeded GL106 violations: 64-bit dtypes at and inside the kernel."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PALLAS_CONTRACT = {
+    "u64_tile": {
+        "bindings": {},
+        # u64 at the input boundary -> GL106
+        "in_dtypes": ["uint64"],
+        "kernel_fns": ["_k64"],
+    },
+}
+
+
+def _k64(x_ref, o_ref):
+    # 64-bit constant reference inside a kernel body -> GL106
+    o_ref[...] = x_ref[...].astype(jnp.int64)
+
+
+def u64_tile(x):
+    return pl.pallas_call(
+        _k64,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        # u64 out_shape -> GL106
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint64),
+    )(x)

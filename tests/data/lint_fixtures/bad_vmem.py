@@ -1,0 +1,33 @@
+"""Seeded GL105 violation: resident blocks far beyond the VMEM budget."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PALLAS_CONTRACT = {
+    "huge_tile": {
+        # 4096 x 4096 f32 in + out + scratch = 3 x 64 MiB, way past
+        # the 16 MiB x 0.5 budget -> GL105
+        "bindings": {"n": 4096},
+        "in_dtypes": ["float32"],
+        "kernel_fns": ["_k"],
+    },
+}
+
+
+def _k(x_ref, o_ref, s_ref):
+    o_ref[...] = x_ref[...]
+
+
+def huge_tile(x):
+    return pl.pallas_call(
+        _k,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((n, n), lambda i: (i, 0),  # noqa: F821
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((n, n), lambda i: (i, 0),  # noqa: F821
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((4096, 4096), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((n, n), jnp.float32)],  # noqa: F821
+    )(x)

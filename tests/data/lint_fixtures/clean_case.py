@@ -1,0 +1,48 @@
+"""Negative fixture: a fully contract-compliant module. Every checker
+must report zero findings here."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 128
+
+PALLAS_CONTRACT = {
+    "good_tile": {
+        "bindings": {"rows": 8},
+        "in_dtypes": ["float32"],
+        "kernel_fns": ["_k"],
+    },
+}
+
+
+def _k(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * jnp.float32(2)
+
+
+def good_tile(x):
+    return pl.pallas_call(
+        _k,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((rows, TILE), lambda i: (i, 0),  # noqa: F821
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((rows, TILE), lambda i: (i, 0),  # noqa: F821
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, TILE), jnp.float32),
+    )(x)
+
+
+@jax.jit
+def good_jit(x):
+    if x.shape[0] > 2:
+        return jnp.sum(x)
+    return x
+
+
+def read_registered_flag():
+    from galah_tpu.config import env_value
+
+    return env_value("GALAH_TPU_PAIRLIST_BLOCK")

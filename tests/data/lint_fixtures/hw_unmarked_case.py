@@ -1,0 +1,21 @@
+"""Seeded GL601 violation: hardware-only tests missing slow/hardware
+markers. Imports the quarantined Mosaic kernel, which makes any
+test_*.py module hardware-only."""
+
+import pytest
+
+from galah_tpu.ops import pallas_sketch
+
+
+def test_kernel_on_hardware():
+    assert pallas_sketch is not None
+
+
+@pytest.mark.parametrize("n", [1, 2])
+def test_kernel_cases(n):
+    assert n > 0
+
+
+@pytest.mark.slow
+def test_properly_marked():
+    pass

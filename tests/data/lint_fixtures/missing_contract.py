@@ -1,0 +1,22 @@
+"""Seeded GL101 violation: a pallas_call with no PALLAS_CONTRACT."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _k(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def uncontracted_tile(x):
+    return pl.pallas_call(
+        _k,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(x)

@@ -1,0 +1,275 @@
+"""galah-tpu lint: every checker demonstrated on seeded-violation
+fixtures, the clean-fixture negative, suppression/baseline mechanics,
+and the tier-1 gate that the repo itself lints clean."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from galah_tpu.analysis import (DEFAULT_BASELINE, CHECK_NAMES,
+                                load_sources, repo_root, run_checks,
+                                run_lint)
+from galah_tpu.analysis import core
+from galah_tpu.analysis.core import Severity, SourceFile
+from galah_tpu.analysis.flags_check import check_flag_references
+from galah_tpu.analysis.markers_check import (check_markers_file,
+                                              is_hardware_module)
+from galah_tpu.analysis.pallas_check import check_pallas_file
+from galah_tpu.analysis.runtime_checks import check_runtime_file
+
+FIXTURES = pathlib.Path(__file__).parent / "data" / "lint_fixtures"
+
+
+def load_fixture(name: str, path: str = None) -> SourceFile:
+    src = SourceFile.load(str(FIXTURES / name))
+    if path is not None:
+        src.path = path
+    return src
+
+
+def codes(findings):
+    return sorted({f.code for f in findings if not f.suppressed})
+
+
+# ---------------------------------------------------------------------------
+# GL1xx: Pallas contract checker
+# ---------------------------------------------------------------------------
+
+
+def test_bad_blockspec_fires_lane_and_sublane():
+    found = check_pallas_file(load_fixture("bad_blockspec.py"))
+    assert "GL103" in codes(found)
+    assert "GL104" in codes(found)
+
+
+def test_u64_boundary_and_kernel_body_fire():
+    found = check_pallas_file(load_fixture("bad_u64.py"))
+    gl106 = [f for f in found if f.code == "GL106"]
+    # input boundary, out_shape, and the kernel-body reference
+    assert len(gl106) >= 3
+
+
+def test_vmem_budget_overflow_fires():
+    found = check_pallas_file(load_fixture("bad_vmem.py"))
+    assert "GL105" in codes(found)
+
+
+def test_missing_contract_fires():
+    found = check_pallas_file(load_fixture("missing_contract.py"))
+    assert codes(found) == ["GL101"]
+
+
+def test_stale_contract_entry_fires():
+    src = load_fixture("missing_contract.py")
+    contract = {"no_such_function": {"bindings": {}}}
+    found = check_pallas_file(src, contract=contract)
+    assert "GL101" in codes(found)  # the real site is still uncovered
+    assert "GL102" in codes(found)  # and the entry is stale
+
+
+# ---------------------------------------------------------------------------
+# GL2xx/GL3xx: host-sync and recompile churn
+# ---------------------------------------------------------------------------
+
+
+def test_jit_fixture_fires_every_runtime_code():
+    found = check_runtime_file(load_fixture("bad_jit.py"))
+    got = codes(found)
+    assert {"GL201", "GL202", "GL203", "GL301", "GL302"} <= set(got)
+
+
+def test_shape_access_is_exempt():
+    found = check_runtime_file(load_fixture("bad_jit.py"))
+    assert not [f for f in found if f.symbol == "clean_shapes"]
+
+
+# ---------------------------------------------------------------------------
+# GL4xx: flag registry
+# ---------------------------------------------------------------------------
+
+
+def test_unregistered_and_conflicting_default_fire():
+    found = check_flag_references([load_fixture("bad_flags.py")])
+    by_code = {f.code: f for f in found if f.path.endswith("bad_flags.py")}
+    assert "GL401" in by_code and "GALAH_TPU_CAHCE" in by_code["GL401"].message
+    assert "GL402" in by_code
+    assert "GALAH_TPU_PAIRLIST_BLOCK" in by_code["GL402"].message
+    # the matching-default read must NOT fire
+    assert not [f for f in found
+                if f.code == "GL402"
+                and "GALAH_TPU_SPARSE_MIN_N" in f.message]
+
+
+def test_registry_is_documented_and_rendered():
+    """GL403/404/405 health over the real repo tree: every registered
+    flag referenced (or externally owned), documented, and present in
+    the auto-rendered manpage ENVIRONMENT section."""
+    sources = load_sources(repo_root())
+    found = check_flag_references(list(sources.values()))
+    assert not [f for f in found if f.code in ("GL403", "GL404", "GL405")], \
+        [f.message for f in found]
+
+
+def test_manpage_renders_every_flag():
+    from galah_tpu.config import FLAGS
+    from galah_tpu.manpage import render_environment_section
+
+    section = render_environment_section()
+    for name in FLAGS:
+        assert name in section
+
+
+# ---------------------------------------------------------------------------
+# GL6xx: hardware-test marker audit
+# ---------------------------------------------------------------------------
+
+
+def test_unmarked_hardware_tests_fire():
+    src = load_fixture("hw_unmarked_case.py",
+                       path="tests/test_tpu_hw_seeded.py")
+    assert is_hardware_module(src)
+    found = check_markers_file(src)
+    flagged = {f.symbol for f in found}
+    assert flagged == {"test_kernel_on_hardware", "test_kernel_cases"}
+    # the quarantined-import heuristic works without the filename too
+    src2 = load_fixture("hw_unmarked_case.py",
+                        path="tests/test_quarantined_seeded.py")
+    assert is_hardware_module(src2)
+
+
+def test_module_level_pytestmark_satisfies_audit():
+    src = load_fixture("hw_unmarked_case.py",
+                       path="tests/test_tpu_hw_seeded.py")
+    src.text = "pytestmark = pytest.mark.slow\n" + src.text
+    import ast
+
+    src.tree = ast.parse(src.text)
+    assert check_markers_file(src, force_hardware=True) == []
+
+
+def test_repo_hardware_tests_are_marked():
+    sources = load_sources(repo_root())
+    found = []
+    for src in sources.values():
+        found.extend(check_markers_file(src))
+    assert not found, [f.message for f in found]
+
+
+# ---------------------------------------------------------------------------
+# Clean fixture, suppressions, baseline
+# ---------------------------------------------------------------------------
+
+
+def test_clean_fixture_has_zero_findings():
+    src = load_fixture("clean_case.py")
+    found = (check_pallas_file(src) + check_runtime_file(src)
+             + [f for f in check_flag_references([src])
+                if f.path == src.path]
+             + check_markers_file(src))
+    assert found == []
+
+
+def test_inline_suppression_and_wildcard():
+    import ast
+
+    text = ("import os\n"
+            "a = os.environ.get('GALAH_BOGUS')  "
+            "# galah-lint: ignore[GL401]\n"
+            "# galah-lint: ignore[*]\n"
+            "b = os.environ.get('GALAH_BOGUS2')\n")
+    src = SourceFile(path="x.py", text=text, tree=ast.parse(text))
+    src._index_suppressions()
+    found = [f for f in check_flag_references([src]) if f.path == "x.py"]
+    core.apply_suppressions(found, {"x.py": src}, {})
+    assert all(f.suppressed and f.suppression == "inline" for f in found)
+
+
+def test_baseline_suppresses_by_fingerprint(tmp_path):
+    src = load_fixture("bad_flags.py")
+    found = [f for f in check_flag_references([src])
+             if f.path.endswith("bad_flags.py")]
+    assert found
+    bl = tmp_path / "baseline.json"
+    core.write_baseline(str(bl), found)
+    baseline = core.load_baseline(str(bl))
+    fresh = [f for f in check_flag_references([src])
+             if f.path.endswith("bad_flags.py")]
+    core.apply_suppressions(fresh, {}, baseline)
+    assert all(f.suppressed and f.suppression == "baseline"
+               for f in fresh)
+
+
+# ---------------------------------------------------------------------------
+# GL5xx: abstract-eval shape contracts
+# ---------------------------------------------------------------------------
+
+
+def test_shape_contracts_match_snapshot():
+    from galah_tpu.analysis.shapes import check_shape_contracts
+
+    found = check_shape_contracts()
+    assert found == [], [f.message for f in found]
+
+
+def test_shape_snapshot_drift_fires(monkeypatch, tmp_path):
+    from galah_tpu.analysis import shapes
+
+    snap = shapes.load_snapshot()
+    assert snap, "committed snapshot must exist"
+    # corrupt one entry and drop one op -> GL501 + GL502
+    drifted = {op: dict(cases) for op, cases in snap.items()}
+    first_op = sorted(drifted)[0]
+    first_case = sorted(drifted[first_op])[0]
+    drifted[first_op][first_case] = "float64[3,3]"
+    drifted["ghost.op"] = {"case": "int32[1]"}
+    p = tmp_path / "shape_contracts.json"
+    p.write_text(json.dumps({"version": 1, "contracts": drifted}))
+    monkeypatch.setattr(shapes, "SNAPSHOT_PATH", str(p))
+    found = shapes.check_shape_contracts()
+    assert "GL501" in codes(found)
+    assert any(f.code == "GL502" and "ghost.op" in f.message
+               for f in found)
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 gate: the repo itself lints clean
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean():
+    """Zero unsuppressed findings at WARNING or above across every
+    checker family — the same gate `galah-tpu lint` enforces."""
+    findings = run_lint()
+    bad = core.failing(findings, Severity.WARNING)
+    assert bad == [], "\n" + core.render_human(bad)
+
+
+def test_lint_cli_json_contract():
+    """`galah-tpu lint --json` (via the module entry point, cheap
+    checkers only) emits the machine-readable schema the validation
+    script consumes."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "galah_tpu.analysis", "--json",
+         "--check", "pallas", "--check", "runtime",
+         "--check", "markers"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["version"] == 1
+    assert set(report["summary"]) == {"errors", "warnings", "notes",
+                                      "suppressed"}
+    assert report["summary"]["errors"] == 0
+
+
+def test_baseline_file_is_committed_and_empty():
+    baseline = core.load_baseline(DEFAULT_BASELINE)
+    assert baseline == {}, "repo lints clean; baseline must stay empty"
+    assert pathlib.Path(DEFAULT_BASELINE).is_file()
+
+
+def test_fixture_dir_not_scanned():
+    sources = load_sources(repo_root())
+    assert not [p for p in sources if "lint_fixtures" in p]
