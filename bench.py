@@ -558,7 +558,44 @@ def run_ladder_stages(stages, errors):
         errors.append(f"mega_256: {type(e).__name__}: {e}")
 
 
+def _finalize_obs(result, started_at):
+    """Mirror the bench line into the metrics registry and, when
+    GALAH_OBS_REPORT is set, write the same end-of-run run_report.json
+    a cluster run produces (docs/observability.md) — so bench numbers
+    are diffable with `galah-tpu report --diff` across captures.
+    Telemetry must never lose the bench line: failures append to the
+    errors field instead of raising."""
+    try:
+        from galah_tpu import obs
+        from galah_tpu.config import env_value
+
+        obs.metrics.gauge(
+            "bench." + result["metric"],
+            help="Headline bench metric",
+            unit=result.get("unit", "")).set(result["value"])
+        if result.get("vs_baseline") is not None:
+            obs.metrics.gauge(
+                "bench.vs_baseline",
+                help="Headline metric over the CPU stand-in "
+                     "baseline").set(result["vs_baseline"])
+        for name, val in result.get("stages", {}).items():
+            if isinstance(val, (int, float)) and not isinstance(
+                    val, bool):
+                obs.metrics.gauge(f"bench.{name}").set(val)
+        obs.metrics.counter(
+            "bench.errors",
+            help="Bench stages that failed").inc(
+            len(result.get("errors", [])))
+        report_path = env_value("GALAH_OBS_REPORT") or None
+        obs.finalize("bench", report_path=report_path,
+                     started_at=started_at)
+    except Exception as e:  # noqa: BLE001
+        result.setdefault("errors", []).append(
+            f"obs: {type(e).__name__}: {e}")
+
+
 def main():
+    started_at = time.time()
     result = {
         "metric": "production_pairwise_genome_pairs_per_sec",
         "value": 0.0,
@@ -659,6 +696,7 @@ def main():
         # Strategy matrix still recorded (interpret mode) so a
         # no-tunnel capture is a documented negative, not a silence.
         _run_pairlist_variants_stage(stages, errors, interpret=True)
+        _finalize_obs(result, started_at)
         print(json.dumps(result))
         return
 
@@ -669,6 +707,7 @@ def main():
         result["n_devices"] = jax.device_count()
     except Exception as e:  # noqa: BLE001
         errors.append(f"backend init: {type(e).__name__}: {e}")
+        _finalize_obs(result, started_at)
         print(json.dumps(result))
         return
 
@@ -797,6 +836,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             errors.append(f"e2e-fast: {type(e).__name__}: {e}")
 
+    _finalize_obs(result, started_at)
     print(json.dumps(result))
 
 

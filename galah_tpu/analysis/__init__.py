@@ -11,6 +11,7 @@ Checker families
   GL4xx  GALAH_* config-flag registry consistency
   GL5xx  abstract-eval shape contracts vs committed snapshot
   GL6xx  hardware-test marker audit
+  GL7xx  observability discipline (ad-hoc timing outside obs/)
 
 Suppression: ``# galah-lint: ignore[GL103]`` on the flagged line or
 the line above, or an entry in the committed baseline
@@ -29,7 +30,8 @@ from typing import Dict, List, Optional, Sequence
 from galah_tpu.analysis import core
 from galah_tpu.analysis.core import Finding, Severity, SourceFile
 
-CHECK_NAMES = ("pallas", "runtime", "flags", "markers", "shapes")
+CHECK_NAMES = ("pallas", "runtime", "flags", "markers", "shapes",
+               "obs")
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
                                 "baseline.json")
 
@@ -74,6 +76,10 @@ def run_checks(sources: Dict[str, SourceFile],
     if "shapes" in checks:
         from galah_tpu.analysis.shapes import check_shape_contracts
         findings.extend(check_shape_contracts())
+    if "obs" in checks:
+        from galah_tpu.analysis.obs_check import check_obs_file
+        for src in sources.values():
+            findings.extend(check_obs_file(src))
     return findings
 
 

@@ -1,0 +1,172 @@
+"""Observability discipline (GL7xx): no ad-hoc timing in the pipeline.
+
+The telemetry layer (galah_tpu/obs, docs/observability.md) is where
+durations belong: stage spans via ``utils/timing.stage``, everything
+else via an ``obs.metrics`` histogram's ``.time()`` context manager.
+A raw ``time.perf_counter()`` pair whose delta only ever reaches a log
+line is invisible to the run report and to ``galah-tpu report --diff``
+— exactly the number a regression hunt needs.
+
+Pipeline modules are everything under ``galah_tpu/`` EXCEPT the
+infrastructure that implements the telemetry itself:
+
+  * ``galah_tpu/utils/``     — timing.py IS the sanctioned timer
+  * ``galah_tpu/obs/``       — the metrics/trace/report layer
+  * ``galah_tpu/analysis/``  — the lint suite (host-side tooling)
+
+(scripts/, tests/, and bench.py are outside the GL7xx scope entirely:
+they are harnesses, not the pipeline.)
+
+Checks
+  GL701  direct wall-clock timing call (``time.time`` /
+         ``time.perf_counter`` / ``time.perf_counter_ns`` /
+         ``time.process_time``) in a pipeline module — import aliases
+         (``import time as _t``, ``from time import perf_counter``)
+         are resolved, so renaming does not evade the check.
+         ``time.monotonic`` is deliberately NOT flagged: it is the
+         deadline/budget accounting clock (resilience/policy.py), not
+         a measurement primitive. ``time.sleep`` is not timing at all.
+  GL702  logging call whose literal message embeds a formatted
+         seconds figure (``%.2fs`` / f-string ``{dt:.1f}s``) — the
+         signature of a measured duration that lives only in the log.
+
+Suppression: the usual inline comment on the flagged line or the line
+above, with a justification —
+
+    started = time.time()  # galah-lint: ignore[GL701] wall-clock stamp
+
+Legitimate cases are timestamps (not durations) and log lines whose
+seconds figure is ALSO recorded in the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List
+
+from galah_tpu.analysis.core import (Finding, Severity, SourceFile,
+                                     dotted_name)
+
+# The measurement clocks GL701 bans from pipeline modules.
+TIMING_CALLS = frozenset({
+    "time.time",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.process_time",
+})
+
+_EXEMPT_PREFIXES = ("galah_tpu/utils/", "galah_tpu/obs/",
+                    "galah_tpu/analysis/")
+
+# "%.2fs", "%.1f s", "%fs" inside a %-format log message.
+_PCT_SECONDS_RE = re.compile(r"%\.?\d*f\s?s\b")
+# ".2f"-style format_spec; the following literal must start with "s".
+_SPEC_SECONDS_RE = re.compile(r"^\.\d+f$")
+
+_LOG_METHODS = frozenset({"debug", "info", "warning", "error",
+                          "critical", "exception", "log"})
+
+
+def in_scope(path: str) -> bool:
+    """True for pipeline modules: galah_tpu/ minus the telemetry and
+    tooling packages (module docstring)."""
+    p = path.replace("\\", "/")
+    if not p.startswith("galah_tpu/"):
+        return False
+    return not p.startswith(_EXEMPT_PREFIXES)
+
+
+def _time_aliases(tree: ast.Module) -> Dict[str, str]:
+    """name-as-written -> canonical dotted name for the time module
+    and its banned members, resolving import aliases."""
+    alias: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    alias[a.asname or a.name] = "time"
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                full = f"time.{a.name}"
+                if full in TIMING_CALLS:
+                    alias[a.asname or a.name] = full
+    return alias
+
+
+def _is_log_call(node: ast.Call) -> bool:
+    """logger.warning(...), logging.info(...), self._log.debug(...)."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _LOG_METHODS:
+        return False
+    owner = dotted_name(fn.value)
+    base = owner.split(".")[-1].lower()
+    return "log" in base
+
+
+def _literal_has_seconds(node: ast.AST) -> bool:
+    """A string literal (plain or f-string) formatting a seconds
+    figure: '%.2fs' or f'{dt:.1f}s'."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return bool(_PCT_SECONDS_RE.search(node.value))
+    if isinstance(node, ast.JoinedStr):
+        parts = node.values
+        for i, part in enumerate(parts):
+            if not isinstance(part, ast.FormattedValue):
+                continue
+            spec = part.format_spec
+            if not (isinstance(spec, ast.JoinedStr) and spec.values):
+                continue
+            s0 = spec.values[0]
+            if not (isinstance(s0, ast.Constant)
+                    and isinstance(s0.value, str)
+                    and _SPEC_SECONDS_RE.match(s0.value)):
+                continue
+            nxt = parts[i + 1] if i + 1 < len(parts) else None
+            if (isinstance(nxt, ast.Constant)
+                    and isinstance(nxt.value, str)
+                    and nxt.value.startswith("s")):
+                return True
+    return False
+
+
+def check_obs_file(src: SourceFile) -> List[Finding]:
+    """GL701/GL702 over one source file (no-op outside the scope)."""
+    if not in_scope(src.path):
+        return []
+    findings: List[Finding] = []
+    aliases = _time_aliases(src.tree)
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        resolved = None
+        if name in TIMING_CALLS:
+            resolved = name
+        elif "." in name:
+            head, _, tail = name.partition(".")
+            if aliases.get(head) == "time" and f"time.{tail}" in \
+                    TIMING_CALLS:
+                resolved = f"time.{tail}"
+        elif aliases.get(name) in TIMING_CALLS:
+            resolved = aliases[name]
+        if resolved is not None:
+            findings.append(Finding(
+                "GL701", Severity.WARNING, src.path, node.lineno,
+                f"direct {resolved}() in a pipeline module — measure "
+                "durations with an obs.metrics histogram's .time() "
+                "(or a utils/timing stage) so they land in the run "
+                "report, not only in locals"))
+            continue
+        if _is_log_call(node) and any(
+                _literal_has_seconds(a) for a in node.args):
+            # anchor at the message literal so a suppression comment
+            # sits next to the offending format, not the call head
+            lit = next(a for a in node.args if _literal_has_seconds(a))
+            findings.append(Finding(
+                "GL702", Severity.WARNING, src.path, lit.lineno,
+                "log message formats a seconds figure — a measured "
+                "duration that lives only in the log; record it in "
+                "the obs.metrics registry (and log it too if useful) "
+                "so `galah-tpu report --diff` can see it"))
+    return findings

@@ -298,7 +298,13 @@ def generate_galah_clusterer(
             "Specify at most one of --checkm-tab-table, "
             "--checkm2-quality-report and --genome-info")
     if not given:
-        logger.warning(
+        from galah_tpu.utils.logging import warn_once
+
+        # Repeated construction (bench rungs, embedding tools) must not
+        # repeat this once-per-run fact — BENCH_r05's tail carried one
+        # copy per invocation site.
+        warn_once(
+            logger,
             "Since CheckM input is missing, genomes are not being ordered "
             "by quality. Instead the order of their input is being used")
     else:

@@ -116,6 +116,12 @@ class ProfileStore:
             subsample_c=self.subsample_c)
 
     def _store_disk(self, path: str, prof: GenomeProfile) -> None:
+        from galah_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.counter(
+            "sketch.profiles_computed",
+            help="Fragment-ANI genome profiles computed (not served "
+                 "from any cache)", unit="genomes").inc()
         self.disk.store(path, "profile", self._params(), {
             "flat_hashes": prof.flat_hashes,
             "ref_set": prof.ref_set,

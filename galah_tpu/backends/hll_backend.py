@@ -89,6 +89,12 @@ class HLLPreclusterer(PreclusterBackend):
                 batched=device_transfer_bound(),
                 workers=self.threads):
             by_path[path] = row
+            from galah_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.counter(
+                "sketch.hll_computed",
+                help="HLL register rows computed (not served from any "
+                     "cache)", unit="genomes").inc()
             self.cache.store(path, "hll", params, {"regs": row})
         return by_path
 

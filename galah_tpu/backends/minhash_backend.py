@@ -77,6 +77,12 @@ class SketchStore:
 
     def insert(self, path: str, s: MinHashSketch) -> MinHashSketch:
         """Record a computed sketch in memory and the disk cache."""
+        from galah_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.counter(
+            "sketch.minhash_computed",
+            help="MinHash sketches computed (not served from any "
+                 "cache)", unit="genomes").inc()
         self.cache.store(path, "minhash", self._params(),
                          {"hashes": s.hashes})
         self._sketches[path] = s

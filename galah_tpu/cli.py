@@ -84,6 +84,18 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--profile-trace-dir",
                    help="Capture an XLA profiler trace of the run into "
                         "this directory (TensorBoard-loadable)")
+    c.add_argument("--trace-events",
+                   help="Write a Chrome-trace-format event timeline "
+                        "(stage spans, JAX compile events, resilience "
+                        "events; Perfetto-loadable) to this file. Env "
+                        "equivalent: GALAH_OBS_TRACE_EVENTS")
+    c.add_argument("--run-report",
+                   help="Write the machine-readable run_report.json "
+                        "(stage tree, dispatch counts, precluster "
+                        "funnel, flag snapshot, resilience events) to "
+                        "this file at run end; render or diff it with "
+                        "`galah-tpu report`. Env equivalent: "
+                        "GALAH_OBS_REPORT")
     c.add_argument("--checkpoint-dir",
                    help="Persist the distance pass and finished "
                         "preclusters here; an interrupted run resumes "
@@ -166,8 +178,25 @@ def build_parser() -> argparse.ArgumentParser:
     from galah_tpu.analysis import add_lint_arguments
 
     add_lint_arguments(li)
+
+    rp = sub.add_parser(
+        "report",
+        help="Render or diff run_report.json files from past runs",
+        description="Human-readable rendering of the machine-readable "
+                    "run report a `cluster --run-report` run wrote "
+                    "(stage wall-clock tree, dispatch/sync counts, "
+                    "precluster funnel, flag snapshot, resilience "
+                    "events); with --diff, per-stage and per-metric "
+                    "deltas between two reports")
+    _add_verbosity(rp)
+    rp.add_argument("paths", nargs="+", metavar="REPORT",
+                    help="run_report.json file(s) to render")
+    rp.add_argument("--diff", action="store_true",
+                    help="Compare exactly two reports: per-stage "
+                         "wall-clock, dispatch/funnel, and per-metric "
+                         "deltas")
     parser._subcommand_parsers = {"cluster": c, "cluster-validate": v,
-                                  "dist": dd, "lint": li}
+                                  "dist": dd, "lint": li, "report": rp}
     return parser
 
 
@@ -221,6 +250,33 @@ def run_dist(args) -> int:
 
 
 def run_cluster(args) -> int:
+    import time as _time
+
+    from galah_tpu import obs
+    from galah_tpu.config import env_value
+
+    # Telemetry lifecycle brackets the whole run: reset shared state,
+    # open the trace sink if requested, and always finalize (write the
+    # run report, close the trace) even when the run fails — a report
+    # of a failed run is exactly when the stage tree matters most.
+    # wall-clock stamp for the report header, not a duration measure
+    started_at = _time.time()  # galah-lint: ignore[GL701]
+    timing.reset()
+    obs.reset_run()
+    trace_path = (getattr(args, "trace_events", None)
+                  or env_value("GALAH_OBS_TRACE_EVENTS"))
+    if trace_path:
+        obs.trace.start(trace_path)
+    report_path = (getattr(args, "run_report", None)
+                   or env_value("GALAH_OBS_REPORT"))
+    try:
+        return _run_cluster_inner(args)
+    finally:
+        obs.finalize("cluster", report_path=report_path,
+                     started_at=started_at)
+
+
+def _run_cluster_inner(args) -> int:
     from galah_tpu.genome_inputs import parse_genome_inputs
     from galah_tpu.io import diskcache
     from galah_tpu.outputs import setup_outputs, write_outputs
@@ -231,7 +287,6 @@ def run_cluster(args) -> int:
     # host computes identical clusters; only process 0 writes outputs.
     distributed.initialize()
 
-    timing.reset()
     from galah_tpu.resilience.quarantine import QuarantineManifest
 
     on_bad_genome = getattr(args, "on_bad_genome", "error") or "error"
@@ -393,6 +448,38 @@ def run_cluster_validate(args) -> int:
     return 0
 
 
+def run_report_cmd(args) -> int:
+    """Render run_report.json files, or diff two of them."""
+    from galah_tpu.obs import report as report_mod
+
+    loaded = []
+    for path in args.paths:
+        try:
+            rep = report_mod.load(path)
+        except Exception as e:  # noqa: BLE001 — bad JSON, missing file
+            logger.error("%s: cannot read run report (%s)", path, e)
+            return 1
+        problems = report_mod.validate(rep)
+        if problems:
+            logger.error("%s: not a valid run report: %s", path,
+                         problems[0])
+            return 1
+        loaded.append((path, rep))
+    if args.diff:
+        if len(loaded) != 2:
+            logger.error("report --diff takes exactly two reports, "
+                         "got %d", len(loaded))
+            return 1
+        (pa, ra), (pb, rb) = loaded
+        sys.stdout.write(report_mod.diff(ra, rb, label_a=pa, label_b=pb))
+        return 0
+    for i, (path, rep) in enumerate(loaded):
+        if i:
+            sys.stdout.write("\n")
+        sys.stdout.write(report_mod.render(rep))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -423,6 +510,10 @@ def main(argv=None) -> int:
         return lint_main(args=args)
     set_log_level(verbose=getattr(args, "verbose", False),
                   quiet=getattr(args, "quiet", False))
+    if args.subcommand == "report":
+        # Pure file I/O — never touches jax, so it skips the platform
+        # probe and works on hosts with no usable accelerator at all.
+        return run_report_cmd(args)
     platform = (getattr(args, "platform", None)
                 or os.environ.get("GALAH_TPU_PLATFORM"))
     if platform:

@@ -135,6 +135,17 @@ def cluster(
             wasted = len(computed_keys - consulted)
             timing.counter("exact-ani-computed", len(computed_keys))
             timing.counter("exact-ani-wasted", wasted)
+            from galah_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.counter(
+                "ani.exact_computed",
+                help="Exact ANI pairs the backend computed",
+                unit="pairs").inc(len(computed_keys))
+            obs_metrics.counter(
+                "ani.exact_wasted",
+                help="Backend-computed ANI pairs no greedy decision "
+                     "ever consulted (speculation waste)",
+                unit="pairs").inc(wasted)
             if computed_keys:
                 logger.debug(
                     "precluster %d: %d exact ANIs computed, %d never "
@@ -200,17 +211,27 @@ def _guarded_ani_batch(
     wedged batched kernel) degrades throughput instead of killing the
     run. Fallback results still flow through the batch validator.
     """
+    from galah_tpu.obs import metrics as obs_metrics
     from galah_tpu.resilience import dispatch as rdispatch
 
     def fallback() -> List[Optional[float]]:
         return [clusterer.calculate_ani_batch([p])[0]
                 for p in path_pairs]
 
-    return rdispatch.run(
-        "dispatch.ani",
-        lambda: clusterer.calculate_ani_batch(path_pairs),
-        fallback=fallback,
-        validate=rdispatch.expect_ani_values(len(path_pairs)))
+    obs_metrics.counter(
+        "ani.batch_pairs",
+        help="Genome pairs submitted to batched exact-ANI dispatches",
+        unit="pairs").inc(len(path_pairs))
+    with obs_metrics.histogram(
+            "ani.batch_seconds",
+            help="Wall-clock latency of one guarded batched exact-ANI "
+                 "dispatch (retries and fallback included)",
+            unit="s").time():
+        return rdispatch.run(
+            "dispatch.ani",
+            lambda: clusterer.calculate_ani_batch(path_pairs),
+            fallback=fallback,
+            validate=rdispatch.expect_ani_values(len(path_pairs)))
 
 
 def _batch_ani(

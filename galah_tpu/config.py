@@ -75,7 +75,8 @@ class Flag:
     help: str                       # one-line doc (manpage ENVIRONMENT)
     default: Optional[str] = None   # None == unset; always the string form
     kind: str = "str"               # str | int | float | bool | grammar
-    section: str = "runtime"        # runtime|kernel|resilience|bench|test|scripts
+    # runtime|kernel|resilience|observability|bench|test|scripts
+    section: str = "runtime"
     choices: Tuple[str, ...] = ()
     # Where the read happens outside the python tree the linter scans
     # (C sources, shell scripts) — suppresses the unread-flag notice.
@@ -155,6 +156,18 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
     Flag("GALAH_TPU_NO_AVX512", kind="bool", section="kernel",
          help="Keep the C merge counter off its AVX-512 kernel",
          external_reader="csrc/pairstats.c (getenv)"),
+    # -- observability -----------------------------------------------------
+    Flag("GALAH_OBS_REPORT", section="observability",
+         help="Write the end-of-run run_report.json (stage tree, "
+              "dispatch counts, precluster funnel, flag snapshot, "
+              "resilience events) to this path; the --run-report "
+              "flag's env twin and loses to it. Render or diff with "
+              "`galah-tpu report` (docs/observability.md)"),
+    Flag("GALAH_OBS_TRACE_EVENTS", section="observability",
+         help="Write Chrome-trace-format span/events (stage spans, "
+              "JAX compile events, resilience events; Perfetto-"
+              "loadable) to this path; the --trace-events flag's env "
+              "twin and loses to it"),
     # -- resilience --------------------------------------------------------
     Flag("GALAH_FI", kind="grammar", section="resilience",
          help="Deterministic fault injection, e.g. "

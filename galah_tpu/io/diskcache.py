@@ -80,9 +80,13 @@ class CacheDir:
             with np.load(entry) as z:
                 out = {name: z[name] for name in z.files}
             self.hits += 1
+            self._count("cache.hits",
+                        "Sketch/profile cache entries reused from disk")
             return out
         except FileNotFoundError:
             self.misses += 1
+            self._count("cache.misses",
+                        "Sketch/profile cache lookups that recomputed")
             return None
         except Exception as exc:  # corrupt entry: drop and recompute
             logger.warning("Dropping unreadable cache entry %s (%s)",
@@ -92,7 +96,18 @@ class CacheDir:
             except OSError:
                 pass
             self.misses += 1
+            self._count("cache.misses",
+                        "Sketch/profile cache lookups that recomputed")
             return None
+
+    @staticmethod
+    def _count(name: str, help: str) -> None:
+        # Mirrored into the run report's precluster funnel (cache hit
+        # rate); loads can come from prefetch worker threads, which the
+        # registry lock makes safe.
+        from galah_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.counter(name, help=help).inc()
 
     def store(self, genome_path: str, kind: str, params: dict,
               arrays: Dict[str, np.ndarray]) -> None:

@@ -48,6 +48,22 @@ def _shared_pool(workers: int) -> ThreadPoolExecutor:
         return _POOL
 
 
+def _adopting(fn: Callable[..., T]) -> Callable[..., T]:
+    """Wrap a pool-submitted callable so timing/trace emission from the
+    prefetch thread attributes to the stage that SUBMITTED the work —
+    without this, a worker's dispatch() lands on the thread-local stage
+    stack of a pool thread that never entered any stage."""
+    from galah_tpu.utils import timing
+
+    token = timing.stage_token()
+
+    def wrapped(*a):
+        with timing.adopt(token):
+            return fn(*a)
+
+    return wrapped
+
+
 def _settle(futures) -> None:
     """Cancel queued look-ahead futures and wait out already-running
     ones, so an abandoned generator (close/GeneratorExit/exception)
@@ -146,7 +162,8 @@ def process_stream(
                 p, item = next(it)
             except StopIteration:
                 return False
-            pending.append((p, pool.submit(single_fn, p, item)))
+            pending.append((p, pool.submit(_adopting(single_fn),
+                                           p, item)))
             return True
 
         try:
@@ -180,12 +197,13 @@ def iter_prefetched(
     pending = []
     try:
         for idx in range(min(depth, len(paths))):
-            pending.append(pool.submit(load_fn, paths[idx]))
+            pending.append(pool.submit(_adopting(load_fn), paths[idx]))
         for i, path in enumerate(paths):
             fut = pending.pop(0)
             nxt = i + depth
             if nxt < len(paths):
-                pending.append(pool.submit(load_fn, paths[nxt]))
+                pending.append(pool.submit(_adopting(load_fn),
+                                           paths[nxt]))
             yield path, fut.result()
     finally:
         _settle(pending)

@@ -81,6 +81,14 @@ _SECTIONS: List[Tuple[str, str, List[str]]] = [
      "persist across runs, and long runs can checkpoint and resume.",
      ["--threads", "--sketch-cache", "--checkpoint-dir",
       "--profile-trace-dir"]),
+    ("OBSERVABILITY",
+     "Every run can emit a machine-readable run_report.json (stage "
+     "wall-clock tree, dispatch/sync round-trip counts, the "
+     "precluster funnel, config-flag snapshot, and resilience "
+     "events) and a Chrome-trace-format event timeline loadable in "
+     "Perfetto alongside the XLA profile. Render or compare reports "
+     "with `galah-tpu report [--diff A B]`. See docs/observability.md.",
+     ["--run-report", "--trace-events"]),
 ]
 
 _EPILOGS = {
@@ -123,6 +131,30 @@ EXIT STATUS
 EXAMPLES
       galah-tpu cluster-validate --cluster-file clusters.tsv --ani 95
 """,
+    "report": """\
+REPORT CONTENTS
+   A run report (produced by `cluster --run-report PATH` or the
+   GALAH_OBS_REPORT variable, schema committed at
+   galah_tpu/obs/run_report.schema.json) records the stage wall-clock
+   tree, per-stage device dispatch and host-sync round trips, the
+   precluster funnel (possible -> screened -> kept -> ANI-computed
+   pairs plus sketch-cache hit rate), the full GALAH_* flag snapshot,
+   device topology, typed metrics, and every resilience event
+   (retries, CPU-fallback demotions, quarantined genomes).
+
+EXIT STATUS
+   0 on success (including a clean diff); 1 on unreadable or
+   schema-invalid input.
+
+EXAMPLES
+   Render one report:
+
+      galah-tpu report run_report.json
+
+   Diff two runs stage-by-stage and metric-by-metric:
+
+      galah-tpu report --diff before.json after.json
+""",
 }
 
 
@@ -130,6 +162,7 @@ _ENV_SECTION_TITLES = [
     ("runtime", "Runtime and IO"),
     ("kernel", "Kernel and device policy"),
     ("resilience", "Resilience"),
+    ("observability", "Observability"),
     ("bench", "Benchmarks"),
     ("test", "Test selection"),
     ("scripts", "Scripts"),

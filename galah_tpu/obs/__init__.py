@@ -1,0 +1,66 @@
+"""galah-tpu observability: metrics, trace events, run reports.
+
+The unified telemetry layer (docs/observability.md). Three pieces, one
+lifecycle:
+
+  * ``obs.metrics`` — the typed metrics registry (counters, gauges,
+    histograms) with thread-safe emission; everything the StageTimer
+    counts is mirrored here, plus registry-native series like
+    per-batch ANI latency and pairlist waste ratios.
+  * ``obs.trace`` — the Chrome-trace-format span/event recorder behind
+    ``--trace-events PATH`` (Perfetto-loadable, including JAX compile
+    events via jax.monitoring); ``obs.events`` adds structured
+    resilience/warning events to the same timeline and to the report.
+  * ``obs.report`` — assembles ``run_report.json`` at run end
+    (``--run-report PATH`` / ``GALAH_OBS_REPORT``) and powers the
+    ``galah-tpu report`` subcommand (render + ``--diff``).
+
+``reset_run()`` gives a run a clean slate; ``finalize()`` assembles,
+validates, and writes the report.
+
+Import discipline: this package must stay importable without jax and
+without circular imports from utils/timing.py — ``report`` is imported
+lazily, only at assembly time.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from galah_tpu.obs import events, metrics, trace  # noqa: F401
+
+logger = logging.getLogger(__name__)
+
+
+def reset_run() -> None:
+    """Fresh metrics + events for a new run (trace recorder unchanged:
+    its lifetime is the CLI invocation, managed by start/stop)."""
+    metrics.reset()
+    events.reset()
+
+
+def finalize(subcommand: str,
+             report_path: Optional[str] = None,
+             argv: Optional[List[str]] = None,
+             started_at: Optional[float] = None) -> Optional[dict]:
+    """Assemble the run report, validate it against the committed
+    schema, write it when a path is given, and close the trace.
+    Telemetry failures log and return None — they never fail the run."""
+    from galah_tpu.obs import report as report_mod
+
+    out = None
+    try:
+        out = report_mod.assemble(subcommand, argv=argv,
+                                  started_at=started_at)
+        problems = report_mod.validate(out)
+        if problems:  # a bug in assembly, not in the user's run
+            logger.warning("run report failed schema validation: %s",
+                           "; ".join(problems[:5]))
+        if report_path:
+            report_mod.write(report_path, out)
+    except Exception:
+        logger.warning("run report assembly failed", exc_info=True)
+    finally:
+        trace.stop()
+    return out

@@ -1,0 +1,42 @@
+"""Structured run events: retries, demotions, quarantines, warnings.
+
+The resilience layer (PR 1) logs these things; this module makes them
+*data*. Every ``record(kind, **fields)`` appends one timestamped row to
+a process-wide log that the run report serializes under ``"events"``,
+and mirrors it into the Chrome trace (obs/trace.py) as an instant event
+so a Perfetto timeline shows retries/demotions at the moment they
+happened, between the stage spans.
+
+Timestamps are wall-clock epoch seconds (the report is a cross-run
+artifact; perf_counter origins do not compare across processes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+from galah_tpu.obs import trace as _trace
+
+_LOCK = threading.Lock()
+_EVENTS: List[dict] = []
+
+
+def record(kind: str, **fields) -> None:
+    """Append one event row; values must be JSON-serializable."""
+    row: Dict[str, object] = {"kind": kind, "time": time.time()}
+    row.update(fields)
+    with _LOCK:
+        _EVENTS.append(row)
+    _trace.emit_instant(kind, cat="event", args=fields or None)
+
+
+def snapshot() -> List[dict]:
+    with _LOCK:
+        return [dict(r) for r in _EVENTS]
+
+
+def reset() -> None:
+    with _LOCK:
+        _EVENTS.clear()

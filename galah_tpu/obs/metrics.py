@@ -1,0 +1,203 @@
+"""Typed metrics registry: counters, gauges, histograms.
+
+The registry is the machine-readable half of the telemetry layer
+(docs/observability.md): the StageTimer keeps the human-facing stage
+report, while every number a run produces — dispatch counts, pairlist
+waste ratios, per-batch ANI latency — is ALSO registered here so the
+end-of-run ``run_report.json`` (obs/report.py) can carry it without
+scraping log lines.
+
+Thread safety: emission is expected from worker threads (IO prefetch
+pools, per-genome sketching workers), so every mutation happens under
+one registry lock. The rates involved are per-dispatch, not per-element
+— contention is negligible next to a device round trip.
+
+Like timing.GLOBAL and the dispatch supervisor, one process-wide
+registry (``GLOBAL``) backs the module-level helpers so call sites
+never thread a registry object through constructors.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+import time as _time
+from typing import Dict, Iterator, List, Optional, Union
+
+Number = Union[int, float]
+
+
+class Metric:
+    """Base: a named, typed, documented series."""
+
+    kind = "metric"
+
+    def __init__(self, name: str, help: str = "", unit: str = "") -> None:
+        self.name = name
+        self.help = help
+        self.unit = unit
+
+    def snapshot(self) -> dict:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonically increasing count (work done, cache hits, ...)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", unit: str = "",
+                 _lock: Optional[threading.Lock] = None) -> None:
+        super().__init__(name, help, unit)
+        self._lock = _lock or threading.Lock()
+        self.value = 0
+
+    def inc(self, delta: Number = 1) -> None:
+        if delta < 0:
+            raise ValueError(
+                f"counter {self.name} cannot decrease (delta={delta})")
+        with self._lock:
+            self.value += delta
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "unit": self.unit, "help": self.help,
+                "value": self.value}
+
+
+class Gauge(Metric):
+    """Last-written value (a ratio, a config-derived size, ...)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "", unit: str = "",
+                 _lock: Optional[threading.Lock] = None) -> None:
+        super().__init__(name, help, unit)
+        self._lock = _lock or threading.Lock()
+        self.value: Optional[Number] = None
+
+    def set(self, value: Number) -> None:
+        with self._lock:
+            self.value = value
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "unit": self.unit, "help": self.help,
+                "value": self.value}
+
+
+class Histogram(Metric):
+    """Streaming summary of observations: count / sum / min / max /
+    mean (no bucket boundaries to tune; the run report wants honest
+    aggregates, not quantile sketches)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", unit: str = "",
+                 _lock: Optional[threading.Lock] = None) -> None:
+        super().__init__(name, help, unit)
+        self._lock = _lock or threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: Number) -> None:
+        v = float(value)
+        if math.isnan(v):
+            return  # a NaN observation would poison sum/min/max
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    @contextlib.contextmanager
+    def time(self) -> Iterator[None]:
+        """Observe the wall-clock duration of a with-block, in seconds.
+
+        The one sanctioned timing primitive for pipeline modules — the
+        GL701 lint rule bans raw time.perf_counter() there precisely so
+        durations land in the registry instead of ad-hoc log lines."""
+        t0 = _time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(_time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "unit": self.unit, "help": self.help,
+                "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max, "mean": self.mean}
+
+
+class MetricsRegistry:
+    """Get-or-create registry of typed metrics, one lock for all of it."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, unit: str):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help=help, unit=unit, _lock=self._lock)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "",
+                unit: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help, unit)
+
+    def gauge(self, name: str, help: str = "", unit: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help, unit)
+
+    def histogram(self, name: str, help: str = "",
+                  unit: str = "") -> Histogram:
+        return self._get_or_create(Histogram, name, help, unit)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Every metric's current state, JSON-ready, sorted by name."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        return {name: metrics[name].snapshot()
+                for name in sorted(metrics)}
+
+
+# Process-wide registry backing the module-level helpers (the same
+# one-per-process idiom as timing.GLOBAL and dispatch.GLOBAL).
+GLOBAL = MetricsRegistry()
+
+
+def counter(name: str, help: str = "", unit: str = "") -> Counter:
+    return GLOBAL.counter(name, help=help, unit=unit)
+
+
+def gauge(name: str, help: str = "", unit: str = "") -> Gauge:
+    return GLOBAL.gauge(name, help=help, unit=unit)
+
+
+def histogram(name: str, help: str = "", unit: str = "") -> Histogram:
+    return GLOBAL.histogram(name, help=help, unit=unit)
+
+
+def snapshot() -> Dict[str, dict]:
+    return GLOBAL.snapshot()
+
+
+def reset() -> None:
+    """Fresh registry (run start / tests)."""
+    global GLOBAL
+    GLOBAL = MetricsRegistry()

@@ -1,0 +1,374 @@
+"""run_report.json: assemble, validate, render, and diff run reports.
+
+One schema-valid JSON artifact per run (``--run-report PATH`` /
+``GALAH_OBS_REPORT``) carrying everything the hardware windows need to
+diff and attribute: the config-flag snapshot (config.FLAGS registry),
+device topology, the stage wall-clock tree, dispatch/sync round-trip
+counts per stage, the precluster funnel (possible -> screened -> kept
+-> ANI-computed pairs, cache hit rate), every resilience event
+(retries, CPU demotions, quarantined genomes), and the full typed
+metrics snapshot. The committed JSON Schema
+(``run_report.schema.json``) is the contract; ``galah-tpu report``
+renders and diffs these artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
+                           "run_report.schema.json")
+REPORT_VERSION = 1
+
+# disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
+_DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
+_RETRY_RE = re.compile(r"^retries\[(.*)\]$")
+
+
+def flag_snapshot() -> Dict[str, dict]:
+    """Every registered GALAH_* flag: effective value, default, and
+    whether the environment set it (the PR-3 registry is the source)."""
+    from galah_tpu.config import FLAGS, env_value
+
+    snap = {}
+    for name, flag in sorted(FLAGS.items()):
+        raw = os.environ.get(name)
+        snap[name] = {
+            "value": env_value(name),
+            "default": flag.default,
+            "set": raw not in (None, ""),
+            "section": flag.section,
+        }
+    return snap
+
+
+def device_topology() -> dict:
+    """Backend/device/process layout, null-filled when jax is not up.
+
+    Deliberately import-only-if-loaded: assembling a report must never
+    be the thing that first initializes a (possibly wedged) backend.
+    """
+    topo: dict = {"backend": None, "device_count": None,
+                  "process_index": None, "process_count": None,
+                  "jax_version": None, "devices": []}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return topo
+    try:
+        topo["jax_version"] = getattr(jax, "__version__", None)
+        topo["backend"] = jax.default_backend()
+        topo["device_count"] = int(jax.device_count())
+        topo["process_index"] = int(jax.process_index())
+        topo["process_count"] = int(jax.process_count())
+        topo["devices"] = [
+            {"id": int(d.id), "platform": str(d.platform),
+             "device_kind": str(d.device_kind)}
+            for d in jax.devices()]
+    except Exception as exc:  # report assembly must never kill the run
+        logger.debug("device topology unavailable: %s", exc)
+    return topo
+
+
+def _split_dispatch_counters(
+        counters: Dict[str, int]
+) -> Tuple[Dict[str, int], Dict[str, int]]:
+    disp: Dict[str, int] = {}
+    sync: Dict[str, int] = {}
+    for name, value in counters.items():
+        m = _DISP_RE.match(name)
+        if not m:
+            continue
+        (disp if m.group(1) == "disp" else sync)[m.group(2)] = value
+    return disp, sync
+
+
+def assemble(subcommand: str,
+             argv: Optional[List[str]] = None,
+             started_at: Optional[float] = None) -> dict:
+    """The full report dict from the process-wide telemetry state
+    (timing.GLOBAL, obs.metrics, obs.events, the dispatch supervisor,
+    the quarantine counter)."""
+    import galah_tpu
+    from galah_tpu.obs import events as obs_events
+    from galah_tpu.obs import metrics as obs_metrics
+    from galah_tpu.resilience import dispatch as rdispatch
+    from galah_tpu.utils import timing
+
+    timer = timing.GLOBAL
+    counters = timer.counters()
+    disp, sync = _split_dispatch_counters(counters)
+    retries = {}
+    for name, value in counters.items():
+        m = _RETRY_RE.match(name)
+        if m:
+            retries[m.group(1)] = value
+
+    metrics = obs_metrics.snapshot()
+
+    def _metric_value(name: str, default=0):
+        m = metrics.get(name)
+        return m.get("value", default) if m else default
+
+    hits = int(_metric_value("cache.hits") or 0)
+    misses = int(_metric_value("cache.misses") or 0)
+    finished = time.time()
+    report = {
+        "version": REPORT_VERSION,
+        "kind": "galah-tpu-run-report",
+        "run": {
+            "subcommand": subcommand,
+            "argv": list(argv) if argv is not None else list(sys.argv),
+            "started_at": started_at,
+            "finished_at": finished,
+            "duration_s": (finished - started_at
+                           if started_at is not None
+                           else timer.elapsed()),
+            "galah_tpu_version": galah_tpu.__version__,
+        },
+        "flags": flag_snapshot(),
+        "device": device_topology(),
+        "stages": {"total_s": timer.elapsed(), "tree": timer.tree()},
+        "dispatch": {
+            "dispatches": disp,
+            "syncs": sync,
+            "total_dispatches": sum(disp.values()),
+            "total_syncs": sum(sync.values()),
+        },
+        "funnel": {
+            "possible_pairs": counters.get("screen-possible-pairs", 0),
+            "screened_candidates": counters.get("screen-candidates", 0),
+            "kept_pairs": counters.get("screen-kept-pairs", 0),
+            "exact_ani_computed": counters.get("exact-ani-computed", 0),
+            "exact_ani_wasted": counters.get("exact-ani-wasted", 0),
+            "cache": {
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": (hits / (hits + misses)
+                             if hits + misses else None),
+            },
+        },
+        "resilience": {
+            "retries": retries,
+            "demotions": [{"site": d.site, "reason": d.reason}
+                          for d in rdispatch.demotions()],
+            "quarantined_genomes": counters.get(
+                "quarantined-genomes", 0),
+        },
+        "counters": counters,
+        "metrics": metrics,
+        "events": obs_events.snapshot(),
+    }
+    return report
+
+
+def write(path: str, report: dict) -> None:
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    logger.info("Wrote run report to %s", path)
+
+
+def load(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def validate(report: dict) -> List[str]:
+    """Schema-validation errors ([] == valid). Uses jsonschema against
+    the committed schema when available; otherwise a structural check
+    of the required top-level sections so report writing never gains a
+    hard dependency."""
+    with open(SCHEMA_PATH) as fh:
+        schema = json.load(fh)
+    try:
+        import jsonschema
+    except ImportError:
+        required = schema.get("required", [])
+        return [f"missing required section {k!r}" for k in required
+                if k not in report]
+    validator = jsonschema.Draft7Validator(schema)
+    return [f"{'/'.join(str(p) for p in e.absolute_path) or '<root>'}: "
+            f"{e.message}"
+            for e in validator.iter_errors(report)]
+
+
+# ---------------------------------------------------------------------------
+# Human rendering + diffing (`galah-tpu report [--diff]`)
+# ---------------------------------------------------------------------------
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:.2f}s"
+
+
+def _render_stage_tree(tree: List[dict], indent: int = 2) -> List[str]:
+    out = []
+    for node in tree:
+        count = f" x{node['count']}" if node.get("count", 1) > 1 else ""
+        out.append(f"{' ' * indent}{node['name']}: "
+                   f"{_fmt_s(node['total_s'])}{count}")
+        out.extend(_render_stage_tree(node.get("children", []),
+                                      indent + 2))
+    return out
+
+
+def render(report: dict) -> str:
+    """One human-readable page per report."""
+    run = report.get("run", {})
+    dev = report.get("device", {})
+    funnel = report.get("funnel", {})
+    cache = funnel.get("cache", {})
+    res = report.get("resilience", {})
+    disp = report.get("dispatch", {})
+    lines = [
+        f"galah-tpu run report (v{report.get('version')})",
+        f"  subcommand: {run.get('subcommand')}   "
+        f"version: {run.get('galah_tpu_version')}   "
+        f"duration: {_fmt_s(run.get('duration_s', 0.0))}",
+        f"  device: backend={dev.get('backend')} "
+        f"devices={dev.get('device_count')} "
+        f"process={dev.get('process_index')}/{dev.get('process_count')}",
+        "",
+        f"stages (total {_fmt_s(report.get('stages', {}).get('total_s', 0.0))}):",
+    ]
+    lines.extend(_render_stage_tree(
+        report.get("stages", {}).get("tree", [])))
+    lines += [
+        "",
+        f"dispatch round trips: {disp.get('total_dispatches', 0)} "
+        f"dispatches, {disp.get('total_syncs', 0)} syncs",
+    ]
+    for stage_name in sorted(set(disp.get("dispatches", {}))
+                             | set(disp.get("syncs", {}))):
+        lines.append(
+            f"  {stage_name}: "
+            f"disp={disp.get('dispatches', {}).get(stage_name, 0)} "
+            f"sync={disp.get('syncs', {}).get(stage_name, 0)}")
+    hit_rate = cache.get("hit_rate")
+    lines += [
+        "",
+        "precluster funnel:",
+        f"  possible pairs:     {funnel.get('possible_pairs', 0)}",
+        f"  screened candidates:{funnel.get('screened_candidates', 0):>8}",
+        f"  kept pairs:         {funnel.get('kept_pairs', 0)}",
+        f"  exact ANI computed: {funnel.get('exact_ani_computed', 0)} "
+        f"({funnel.get('exact_ani_wasted', 0)} wasted)",
+        f"  sketch cache:       {cache.get('hits', 0)} hits / "
+        f"{cache.get('misses', 0)} misses"
+        + (f" ({100.0 * hit_rate:.0f}% hit rate)"
+           if hit_rate is not None else ""),
+        "",
+        "resilience:",
+        f"  retries:    {res.get('retries', {}) or 'none'}",
+        f"  demotions:  "
+        f"{[d['site'] for d in res.get('demotions', [])] or 'none'}",
+        f"  quarantined genomes: {res.get('quarantined_genomes', 0)}",
+    ]
+    events = report.get("events", [])
+    if events:
+        lines.append(f"  events ({len(events)}):")
+        for ev in events[:20]:
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("kind", "time")}
+            lines.append(f"    {ev.get('kind')}: {extra}")
+        if len(events) > 20:
+            lines.append(f"    ... {len(events) - 20} more")
+    metrics = report.get("metrics", {})
+    if metrics:
+        lines.append("")
+        lines.append("metrics:")
+        for name, m in sorted(metrics.items()):
+            unit = f" {m['unit']}" if m.get("unit") else ""
+            if m.get("kind") == "histogram":
+                mean = m.get("mean")
+                lines.append(
+                    f"  {name}: n={m.get('count', 0)} "
+                    f"mean={mean:.4g}{unit}" if mean is not None
+                    else f"  {name}: n=0")
+            else:
+                lines.append(f"  {name}: {m.get('value')}{unit}")
+    return "\n".join(lines) + "\n"
+
+
+def _flatten_stages(tree: List[dict],
+                    prefix: str = "") -> Dict[str, Tuple[float, int]]:
+    flat: Dict[str, Tuple[float, int]] = {}
+    for node in tree:
+        path = f"{prefix}/{node['name']}" if prefix else node["name"]
+        acc, count = flat.get(path, (0.0, 0))
+        flat[path] = (acc + float(node.get("total_s", 0.0)),
+                      count + int(node.get("count", 0)))
+        flat.update(_flatten_stages(node.get("children", []), path))
+    return flat
+
+
+def _metric_scalar(m: dict) -> Optional[float]:
+    if m.get("kind") == "histogram":
+        return m.get("mean")
+    v = m.get("value")
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def diff(a: dict, b: dict, label_a: str = "A",
+         label_b: str = "B") -> str:
+    """Per-stage and per-metric deltas between two reports (B - A)."""
+    lines = [
+        f"run report diff: {label_a} -> {label_b}",
+        f"  duration: {_fmt_s(a['run']['duration_s'])} -> "
+        f"{_fmt_s(b['run']['duration_s'])} "
+        f"({b['run']['duration_s'] - a['run']['duration_s']:+.2f}s)",
+        "",
+        "per-stage wall clock:",
+    ]
+    sa = _flatten_stages(a.get("stages", {}).get("tree", []))
+    sb = _flatten_stages(b.get("stages", {}).get("tree", []))
+    for path in sorted(set(sa) | set(sb)):
+        ta, _ = sa.get(path, (0.0, 0))
+        tb, _ = sb.get(path, (0.0, 0))
+        marker = ("" if path in sa and path in sb
+                  else f"  [only in {label_a if path in sa else label_b}]")
+        lines.append(f"  {path}: {_fmt_s(ta)} -> {_fmt_s(tb)} "
+                     f"({tb - ta:+.2f}s){marker}")
+
+    lines += ["", "dispatch round trips:"]
+    for key in ("total_dispatches", "total_syncs"):
+        va = a.get("dispatch", {}).get(key, 0)
+        vb = b.get("dispatch", {}).get(key, 0)
+        lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
+
+    lines += ["", "funnel:"]
+    fa, fb = a.get("funnel", {}), b.get("funnel", {})
+    for key in ("possible_pairs", "screened_candidates", "kept_pairs",
+                "exact_ani_computed", "exact_ani_wasted"):
+        va, vb = fa.get(key, 0), fb.get(key, 0)
+        lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
+
+    lines += ["", "per-metric deltas:"]
+    ma = a.get("metrics", {})
+    mb = b.get("metrics", {})
+    for name in sorted(set(ma) | set(mb)):
+        va = _metric_scalar(ma.get(name, {}))
+        vb = _metric_scalar(mb.get(name, {}))
+        if va is None and vb is None:
+            continue
+        delta = ("" if va is None or vb is None
+                 else f" ({vb - va:+.6g})")
+        lines.append(f"  {name}: {va} -> {vb}{delta}")
+
+    ra = {d["site"] for d in a.get("resilience", {}).get("demotions", [])}
+    rb = {d["site"] for d in b.get("resilience", {}).get("demotions", [])}
+    if ra != rb:
+        lines += ["", f"demotions: {sorted(ra)} -> {sorted(rb)}"]
+    return "\n".join(lines) + "\n"

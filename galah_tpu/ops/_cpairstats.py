@@ -130,8 +130,15 @@ def threshold_pairs_c(mat: np.ndarray, sketch_size: int, kmer: int,
                     ctypes.POINTER(ctypes.c_int64)),
                 sketch_size, kmer, float(j_thr), int(threads),
                 out_ani.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
-        timing.counter("screen-kept-pairs",
-                       int((out_ani != float("-inf")).sum()))
+        kept_n = int((out_ani != float("-inf")).sum())
+        timing.counter("screen-kept-pairs", kept_n)
+        from galah_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.gauge(
+            "screen.survival_rate",
+            help="Fraction of screened candidate pairs the threshold "
+                 "kept (last screening pass)", unit="fraction").set(
+            float(kept_n) / pi.shape[0] if pi.shape[0] else 0.0)
         return {(int(a), int(b)): float(v)
                 for a, b, v in zip(pi, pj, out_ani)
                 if v != float("-inf")}

@@ -240,6 +240,13 @@ def positional_hashes_batch(genomes, k: int,
     for chunk_idxs, packed, ambits, offs in group_iter:
         import jax.numpy as jnp
 
+        from galah_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.counter(
+            "hash.batched_genomes",
+            help="Genomes hashed in grouped one-dispatch batches "
+                 "(vs the per-genome chunk pipeline)",
+            unit="genomes").inc(len(chunk_idxs))
         timing.dispatch()
         timing.dispatch(sync=True)
         h = np.asarray(hashing.canonical_kmer_hashes_batch_jit(

@@ -375,6 +375,13 @@ def screen_pairs(
         timing.counter("screen-candidates", int(pi.shape[0]))
         timing.counter("screen-possible-pairs", n * (n - 1) // 2)
         timing.counter("screen-kept-pairs", int(keep.sum()))
+        from galah_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.gauge(
+            "screen.survival_rate",
+            help="Fraction of screened candidate pairs the threshold "
+                 "kept (last screening pass)", unit="fraction").set(
+            float(keep.sum()) / pi.shape[0] if pi.shape[0] else 0.0)
         return list(zip(pi[keep].tolist(), pj[keep].tolist()))
 
     if mesh is None and jax.device_count() > 1:

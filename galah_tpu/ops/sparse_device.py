@@ -554,6 +554,13 @@ def threshold_pairs_sparse(
     total = total.astype(np.int64)
     keep = common.astype(np.float64) >= j_thr * total
     timing.counter("screen-kept-pairs", int(keep.sum()))
+    from galah_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.gauge(
+        "screen.survival_rate",
+        help="Fraction of screened candidate pairs the threshold "
+             "kept (last screening pass)", unit="fraction").set(
+        float(keep.sum()) / pi.shape[0] if pi.shape[0] else 0.0)
     ani = stats_to_ani_f64(common[keep], total[keep], k)
     return {(int(a), int(b)): float(v)
             for a, b, v in zip(pi[keep], pj[keep], ani)}

@@ -32,6 +32,8 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional, TypeVar
 
+from galah_tpu.obs import events as obs_events
+from galah_tpu.obs import metrics as obs_metrics
 from galah_tpu.resilience import faults
 from galah_tpu.resilience.policy import (
     GarbageResultError,
@@ -77,6 +79,11 @@ class DispatchSupervisor:
                 site=site,
                 reason=f"{type(exc).__name__}: {exc}")
         timing.counter(f"demoted[{site}]", 1)
+        obs_metrics.counter(
+            "dispatch.demotions",
+            help="Dispatch sites demoted to their CPU fallback").inc()
+        obs_events.record("demotion", site=site,
+                          reason=f"{type(exc).__name__}: {exc}")
         logger.error(
             "%s: persistent dispatch failure (%s: %s); demoting to "
             "the fallback path for the rest of the run",
@@ -106,8 +113,14 @@ class DispatchSupervisor:
                 validate(out)
             return out
 
-        def on_retry(_attempt: int, _exc: BaseException) -> None:
+        def on_retry(attempt_n: int, exc: BaseException) -> None:
             timing.counter(f"retries[{site}]", 1)
+            obs_metrics.counter(
+                "dispatch.retries",
+                help="Dispatch attempts retried after a transient "
+                     "failure").inc()
+            obs_events.record("retry", site=site, attempt=attempt_n,
+                              error=f"{type(exc).__name__}: {exc}")
 
         try:
             return call_with_retry(attempt, pol, site=site,

@@ -204,6 +204,9 @@ def call_with_retry(
             if (policy.total_budget is not None
                     and time.monotonic() - t0 + d > policy.total_budget):
                 logger.warning(
+                    # the budget is config, not a measurement; retry
+                    # counts land in the registry via on_retry
+                    # galah-lint: ignore[GL702]
                     "%s: retry budget %.1fs exhausted after attempt "
                     "%d", site or "dispatch", policy.total_budget,
                     attempt + 1)
@@ -211,6 +214,9 @@ def call_with_retry(
             if on_retry is not None:
                 on_retry(attempt, e)
             logger.warning(
+                # the delay is the policy's schedule, not a measured
+                # duration
+                # galah-lint: ignore[GL702]
                 "%s: attempt %d/%d failed (%s: %s); retrying in "
                 "%.2fs", site or "dispatch", attempt + 1,
                 policy.max_attempts, type(e).__name__, e, d)

@@ -144,10 +144,14 @@ def preflight_quarantine(
         if distributed.process_count() > 1:
             gathered = distributed.exchange("quarantine-mask", bad)
             bad = gathered.max(axis=0).astype(np.uint8)
+    from galah_tpu.obs import events as obs_events
+
     for i in np.nonzero(bad)[0].tolist():
         reason, detail = reasons.get(
             i, ("corrupt", "flagged by a peer host"))
         manifest.add(unique[i], reason, detail)
+        obs_events.record("quarantine", genome=unique[i],
+                          reason=reason, detail=detail)
     timing.counter("quarantined-genomes", int(bad.sum()))
     dropped = {unique[i] for i in np.nonzero(bad)[0].tolist()}
     kept = [p for p in genome_paths if p not in dropped]

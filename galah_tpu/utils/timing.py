@@ -7,6 +7,20 @@ final report logs one line per stage so a 50k-genome run shows where
 wall-clock went (sketching vs pairwise vs ANI refinement vs host
 clustering).
 
+The StageTimer is the emission surface of the telemetry layer
+(galah_tpu/obs/): every closed span also records into the stage
+wall-clock TREE the run report serializes (obs/report.py) and, when a
+trace recorder is active (--trace-events), lands as a Chrome-trace
+span on the Perfetto timeline (obs/trace.py).
+
+Worker-thread attribution: the active-stage stack is thread-local, but
+dispatches can arrive from worker threads (IO prefetch pools, per-
+genome sketching workers). A thread with an empty local stack inherits
+the innermost stage any thread currently has open (the shared fallback
+stack), and thread pools that want exact attribution capture
+``stage_token()`` in the spawning thread and run workers under
+``adopt(token)``.
+
 `trace_context(dir)` additionally captures a TensorBoard-loadable XLA
 profile via jax.profiler (device timelines, HLO cost, HBM traffic) when
 the user passes --profile-trace-dir.
@@ -20,6 +34,8 @@ import threading as _threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from galah_tpu.obs import trace as _obs_trace
+
 logger = logging.getLogger(__name__)
 
 
@@ -32,8 +48,17 @@ class StageTimer:
         self._order: List[str] = []
         self._counters: Dict[str, int] = {}
         self._counter_order: List[str] = []
+        # wall-clock tree: stage path tuple -> [seconds, count], in
+        # first-appearance order (the run report serializes this)
+        self._tree: Dict[Tuple[str, ...], List[float]] = {}
+        self._tree_order: List[Tuple[str, ...]] = []
         self._t0 = time.perf_counter()
         self._active = _threading.local()
+        # Shared fallback stack: mirrors every open stage across ALL
+        # threads so dispatch() from a bare worker thread (whose
+        # thread-local stack is empty) inherits the spawning stage
+        # instead of landing under "?".
+        self._shared: List[str] = []
         self._lock = _threading.Lock()
 
     def _stack(self) -> List[str]:
@@ -42,6 +67,37 @@ class StageTimer:
             st = self._active.stack = []
         return st
 
+    def current_stage(self) -> Optional[str]:
+        """Innermost stage for THIS thread, falling back to the
+        innermost stage open on any thread."""
+        st = self._stack()
+        if st:
+            return st[-1]
+        with self._lock:
+            return self._shared[-1] if self._shared else None
+
+    def stage_token(self) -> Tuple[str, ...]:
+        """Capture the current stage path for a worker thread to
+        `adopt` — the pass-through form of worker attribution (the
+        shared-stack fallback is the implicit one)."""
+        st = self._stack()
+        if st:
+            return tuple(st)
+        with self._lock:
+            return tuple(self._shared[-1:])
+
+    @contextlib.contextmanager
+    def adopt(self, token: Tuple[str, ...]) -> Iterator[None]:
+        """Run this thread with `token` as its stage context; restores
+        the thread's own stack on exit."""
+        st = self._stack()
+        saved = st[:]
+        st[:] = list(token)
+        try:
+            yield
+        finally:
+            st[:] = saved
+
     def dispatch(self, n: int = 1, sync: bool = False) -> None:
         """Record `n` device dispatches (jit executions / uploads)
         attributed to the innermost active stage — with sync=True they
@@ -49,8 +105,7 @@ class StageTimer:
         On a remote-attached device every round trip costs real RTT;
         these counters let the stage report show round trips alongside
         wall-clock, so dispatch-bound stages are visible as such."""
-        st = self._stack()
-        where = st[-1] if st else "?"
+        where = self.current_stage() or "?"
         self.counter(f"{'sync' if sync else 'disp'}[{where}]", n)
 
     def counter(self, name: str, delta: int) -> None:
@@ -68,26 +123,78 @@ class StageTimer:
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
         start = time.perf_counter()
-        self._stack().append(name)
+        st = self._stack()
+        st.append(name)
+        with self._lock:
+            self._shared.append(name)
         try:
             yield
         finally:
-            self._stack().pop()
+            st.pop()
+            path = tuple(st) + (name,)
+            with self._lock:
+                # drop the most recent matching entry — concurrent
+                # stages on other threads may have pushed above it
+                for k in range(len(self._shared) - 1, -1, -1):
+                    if self._shared[k] == name:
+                        del self._shared[k]
+                        break
             dt = time.perf_counter() - start
-            if name not in self._acc:
-                self._acc[name] = 0.0
-                self._counts[name] = 0
-                self._order.append(name)
-            self._acc[name] += dt
-            self._counts[name] += 1
+            with self._lock:
+                if name not in self._acc:
+                    self._acc[name] = 0.0
+                    self._counts[name] = 0
+                    self._order.append(name)
+                self._acc[name] += dt
+                self._counts[name] += 1
+                if path not in self._tree:
+                    self._tree[path] = [0.0, 0]
+                    self._tree_order.append(path)
+                self._tree[path][0] += dt
+                self._tree[path][1] += 1
+            _obs_trace.emit_complete(name, start, dt, cat="stage")
             logger.debug("stage %s: %.3fs", name, dt)
 
     def items(self) -> List[Tuple[str, float, int]]:
         return [(n, self._acc[n], self._counts[n]) for n in self._order]
 
+    def elapsed(self) -> float:
+        """Wall-clock seconds since this timer was created/reset."""
+        return time.perf_counter() - self._t0
+
+    def tree(self) -> List[dict]:
+        """The nested stage wall-clock tree, JSON-ready: each node is
+        {name, total_s, count, children}, in first-entry order."""
+        with self._lock:
+            paths = list(self._tree_order)
+            data = {p: tuple(v) for p, v in self._tree.items()}
+        nodes: Dict[Tuple[str, ...], dict] = {}
+        roots: List[dict] = []
+
+        def node_for(path: Tuple[str, ...]) -> dict:
+            # Inner stages close (and register) before their parents,
+            # so a parent may not exist yet when its child arrives:
+            # create it on demand — its totals are in `data` already
+            # if it ever closed, zero if it is still open (crash).
+            node = nodes.get(path)
+            if node is None:
+                acc, count = data.get(path, (0.0, 0))
+                node = {"name": path[-1], "total_s": round(acc, 6),
+                        "count": count, "children": []}
+                nodes[path] = node
+                if len(path) == 1:
+                    roots.append(node)
+                else:
+                    node_for(path[:-1])["children"].append(node)
+            return node
+
+        for path in paths:
+            node_for(path)
+        return roots
+
     def report(self, log: Optional[logging.Logger] = None) -> str:
         log = log or logger
-        total = time.perf_counter() - self._t0
+        total = self.elapsed()
         lines = []
         for name, acc, count in self.items():
             share = 100.0 * acc / total if total > 0 else 0.0
@@ -117,6 +224,14 @@ def counter(name: str, delta: int) -> None:
 
 def dispatch(n: int = 1, sync: bool = False) -> None:
     GLOBAL.dispatch(n, sync=sync)
+
+
+def stage_token() -> Tuple[str, ...]:
+    return GLOBAL.stage_token()
+
+
+def adopt(token: Tuple[str, ...]):
+    return GLOBAL.adopt(token)
 
 
 def reset() -> None:

@@ -45,11 +45,22 @@ mkdir -p "$ART"
 run_stage() {  # run_stage <name> <timeout> <cmd...>
   local name=$1 tmo=$2; shift 2
   echo "--- $name $(date -u) ---" >> "$LOG"
+  # Every stage gets a run-report sink (galah_tpu/obs); obs-aware
+  # stages (bench, cluster-driving scripts) archive their telemetry
+  # next to their capture so sessions are diffable with
+  # `galah-tpu report --diff`.
+  local report="$ART/${name}_report.json"
   { echo "=== $name $(date -u) ==="
-    timeout -k 10 "$tmo" "$@" 2>&1
+    timeout -k 10 "$tmo" env GALAH_OBS_REPORT="$report" "$@" 2>&1
     echo "--- exit $? $(date -u) ---"
   } > "$ART/$name.txt"
   cat "$ART/$name.txt" >> "$LOG"
+  # Soft failure: a missing report degrades observability, not the
+  # session — warn and keep going (a hard exit here would throw away
+  # the remaining hardware stages over telemetry).
+  if [ ! -s "$report" ]; then
+    echo "WARN: stage $name produced no run report at $report" >> "$LOG"
+  fi
 }
 
 # One variable governs both the harness kill and bench.py's internal

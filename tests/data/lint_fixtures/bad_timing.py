@@ -1,0 +1,36 @@
+"""GL7xx fixture: ad-hoc timing a pipeline module must not contain."""
+
+import logging
+import time
+from time import perf_counter as pc
+
+logger = logging.getLogger(__name__)
+
+
+def bad_direct():
+    t0 = time.perf_counter()          # GL701
+    work()
+    return time.perf_counter() - t0   # GL701
+
+
+def bad_aliased():
+    import time as _t
+
+    start = _t.time()                 # GL701 (aliased module)
+    work()
+    dt = pc() - start                 # GL701 (from-import alias)
+    logger.info("stage took %.2fs", dt)            # GL702
+    logger.debug(f"warmup was {dt:.1f}s overall")  # GL702
+
+
+def fine():
+    # not flagged: monotonic is the deadline/budget clock, sleep is
+    # not timing, and a suppressed call documents its justification
+    deadline = time.monotonic() + 5.0
+    time.sleep(0.1)
+    stamp = time.time()  # galah-lint: ignore[GL701] wall-clock stamp
+    logger.info("deadline %s stamp %s", deadline, stamp)
+
+
+def work():
+    pass

@@ -159,6 +159,58 @@ def test_repo_hardware_tests_are_marked():
 
 
 # ---------------------------------------------------------------------------
+# GL7xx: observability discipline (ad-hoc timing outside obs/)
+# ---------------------------------------------------------------------------
+
+
+def test_bad_timing_fixture_fires_gl701_and_gl702():
+    from galah_tpu.analysis.obs_check import check_obs_file
+
+    src = load_fixture("bad_timing.py",
+                       path="galah_tpu/ops/bad_timing.py")
+    found = check_obs_file(src)
+    gl701 = sorted(f.line for f in found if f.code == "GL701")
+    gl702 = sorted(f.line for f in found if f.code == "GL702")
+    # direct calls, aliased-module call, from-import alias, and the
+    # (later suppressed) wall-clock stamp; both log-literal shapes
+    assert gl701 == [11, 13, 19, 21, 31]
+    assert gl702 == [22, 23]
+    assert all(f.severity is Severity.WARNING for f in found)
+
+
+def test_bad_timing_inline_suppression_applies():
+    from galah_tpu.analysis.obs_check import check_obs_file
+
+    src = load_fixture("bad_timing.py",
+                       path="galah_tpu/ops/bad_timing.py")
+    found = check_obs_file(src)
+    core.apply_suppressions(found, {src.path: src}, {})
+    active = sorted(f.line for f in found if not f.suppressed)
+    assert active == [11, 13, 19, 21, 22, 23]  # line 31 is justified
+
+
+def test_obs_check_exempts_utils_obs_analysis_and_nonpackage():
+    from galah_tpu.analysis.obs_check import check_obs_file, in_scope
+
+    for path in ("galah_tpu/utils/timing.py",
+                 "galah_tpu/obs/metrics.py",
+                 "galah_tpu/analysis/obs_check.py",
+                 "scripts/smoke.py",
+                 "tests/test_obs.py",
+                 "bench.py"):
+        assert not in_scope(path)
+        assert check_obs_file(load_fixture("bad_timing.py",
+                                           path=path)) == []
+    assert in_scope("galah_tpu/ops/bad_timing.py")
+
+
+def test_repo_has_no_unsuppressed_adhoc_timing():
+    found = [f for f in run_lint(checks=("obs",))
+             if not f.suppressed]
+    assert not found, [(f.path, f.line, f.message) for f in found]
+
+
+# ---------------------------------------------------------------------------
 # Clean fixture, suppressions, baseline
 # ---------------------------------------------------------------------------
 
