@@ -491,6 +491,41 @@ def _run_pairlist_variants_stage(stages, errors, interpret=False):
         errors.append(f"pairlist_variants: {type(e).__name__}: {e}")
 
 
+def _run_fragment_variants_stage(stages, errors, interpret=False):
+    """Per-strategy fragment-ANI throughput + packing-waste breakdown
+    in a subprocess (scripts/bench_fragment_variants.py) — the
+    exact-stage twin of the pairlist matrix: pallas pack sweep with
+    launch/occupancy counters, the xla and C paths on the same pair
+    list, and the bare-kernel amortized dispatch cost. Same isolation
+    rationale: self-budgeting script, subprocess timeout."""
+    _FRAGMENT_COST = 180 if interpret else 300   # hard <=5 min cap
+    if not _admit(_FRAGMENT_COST, "fragment_variants", errors):
+        return
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        cmd = [sys.executable,
+               os.path.join(here, "scripts",
+                            "bench_fragment_variants.py"),
+               "--budget", str(_FRAGMENT_COST - 30)]
+        if interpret:
+            cmd.append("--interpret")
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True,
+            timeout=_FRAGMENT_COST, cwd=here)
+        data = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("FRAGMENT_JSON "):
+                data = json.loads(line[len("FRAGMENT_JSON "):])
+        if data is None:
+            raise RuntimeError(
+                f"rc={proc.returncode}: {proc.stderr[-400:]}")
+        if interpret:
+            data["interpret"] = True
+        stages["fragment_variants"] = data
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"fragment_variants: {type(e).__name__}: {e}")
+
+
 def run_ladder_stages(stages, errors):
     """North-star-relevant e2e evidence in the driver artifact itself.
 
@@ -696,6 +731,7 @@ def main():
         # Strategy matrix still recorded (interpret mode) so a
         # no-tunnel capture is a documented negative, not a silence.
         _run_pairlist_variants_stage(stages, errors, interpret=True)
+        _run_fragment_variants_stage(stages, errors, interpret=True)
         _finalize_obs(result, started_at)
         print(json.dumps(result))
         return
@@ -786,6 +822,11 @@ def main():
     # turns a missed >=25%-of-ceiling target into a documented
     # negative. Self-budgeting inside the subprocess; hard 5 min cap.
     _run_pairlist_variants_stage(stages, errors)
+
+    # 4e. Fragment-ANI strategy matrix: the exact-stage twin — pallas
+    # pack sweep (launches per pair, job/span occupancy), xla and C
+    # baselines, bare-kernel dispatch cost. Same subprocess isolation.
+    _run_fragment_variants_stage(stages, errors)
 
     # 5. Sketching throughput on real FASTA bytes, both hash algos —
     # each with its own watchdog so one failure never loses the other.

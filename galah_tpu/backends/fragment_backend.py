@@ -225,7 +225,16 @@ def _guarded_ani_values(profs, min_aligned_frac: float,
     cluster backends and the skani preclusterer. The per-pair fallback
     trades the coalesced batch for N tiny dispatches, so a persistently
     failing batched kernel degrades throughput, not the run (stage
-    report: demoted[dispatch.fragment-ani])."""
+    report: demoted[dispatch.fragment-ani]).
+
+    Two fallback layers compose here: INSIDE the batch call,
+    fragment_ani resolves the membership strategy
+    (GALAH_TPU_FRAGMENT_STRATEGY: blocked Mosaic kernel / vmapped XLA
+    / C merge, see docs/fragment_kernel.md) and an AUTO-chosen Pallas
+    path already demotes to its XLA twin on Mosaic failure
+    (fragment-pallas-demoted counter); this OUTER guard catches
+    whole-batch failures of whatever strategy won and retries
+    per-pair."""
     from galah_tpu.resilience import dispatch as rdispatch
 
     return rdispatch.run(

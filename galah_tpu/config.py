@@ -133,6 +133,14 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
          section="kernel",
          help="Pairs per program (P) for the blocked Mosaic pairlist "
               "kernel"),
+    Flag("GALAH_TPU_FRAGMENT_STRATEGY", section="kernel",
+         choices=("pallas", "xla", "c"),
+         help="Pin the exact fragment-ANI membership strategy "
+              "(blocked Mosaic kernel / vmapped searchsorted / "
+              "compiled-C merge) instead of the AUTO heuristic"),
+    Flag("GALAH_TPU_FRAGMENT_PAIRS", kind="int", section="kernel",
+         help="Cap on genome pairs packed into one fragment-ANI "
+              "Pallas launch; unset lets the job/volume caps decide"),
     Flag("GALAH_TPU_PALLAS_HASH", kind="bool", section="kernel",
          help="1 forces the quarantined Mosaic murmur3 kernel, 0 "
               "forces the XLA u64 emulation; unset uses the "

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import warnings
 from typing import Optional, Tuple
 
@@ -465,15 +466,51 @@ def directed_ani(
 
 
 def _seq_sum(a: np.ndarray) -> float:
-    """Strictly left-to-right f64 sum (np.add.reduceat order).
+    """f64 sum in np.add.reduceat's order over a COMPRESSED array.
 
-    np.mean/np.sum use pairwise summation above tiny sizes; the
-    batched twin (_directed_from_counts_arrays) reduces segments with
-    np.add.reduceat, which is sequential — both paths use THIS order
-    so their ANI floats are bit-identical, window count regardless."""
+    reduceat's pairwise blocking is a function of the summed run's
+    length, so the only way two code paths produce bit-identical sums
+    is to hand reduceat the same element run: masked windows must be
+    compressed OUT (a[mask]), never zero-filled in place — interleaved
+    +0.0 terms shift the pairwise block boundaries and can move the
+    total a ulp. The batched twin (_directed_from_counts_arrays)
+    reduces each pair's compressed segment with reduceat at the
+    compressed starts (_segment_compressed_sums), which is
+    bit-identical to this call on the segment alone (reduceat's
+    blocking does not depend on the segment's offset)."""
     if a.shape[0] == 0:
         return 0.0
     return float(np.add.reduceat(a, np.zeros(1, dtype=np.intp))[0])
+
+
+def _segment_compressed_sums(
+    values: np.ndarray,   # (W_total,) f64
+    mask: np.ndarray,     # (W_total,) bool — which entries count
+    starts: np.ndarray,   # (n_segs,) segment starts into values
+) -> "Tuple[np.ndarray, np.ndarray]":
+    """Per-segment (sum of values[mask], count of mask) — each sum
+    bit-identical to _seq_sum over that segment's compressed slice.
+
+    Compresses FIRST, then reduceat at the nonempty segments'
+    compressed starts (empty segments occupy zero width in the
+    compressed array, so the next nonempty start is exactly this
+    segment's end — reduceat's [start_i, start_{i+1}) windows line up
+    without materializing per-segment ends, and its empty-segment wart
+    — a zero-width window yields a[start], not 0 — never arises)."""
+    n = starts.shape[0]
+    sums = np.zeros(n, dtype=np.float64)
+    idx = np.flatnonzero(mask)
+    counts = (np.searchsorted(idx, np.append(starts[1:],
+                                             values.shape[0]))
+              - np.searchsorted(idx, starts))
+    if idx.size == 0:
+        return sums, counts
+    comp = values[idx]
+    cstarts = np.searchsorted(idx, starts)
+    nonempty = np.flatnonzero(counts > 0)
+    sums[nonempty] = np.add.reduceat(
+        comp, cstarts[nonempty].astype(np.intp))
+    return sums, counts
 
 
 def _directed_from_counts(
@@ -528,10 +565,11 @@ def _directed_from_counts_arrays(
     min_window_valid_frac: float,
 ):
     """Vectorized batch twin of _directed_from_counts over concatenated
-    per-pair window segments — bit-identical floats (all segment
-    reductions are np.add.reduceat, the same left-to-right order
-    _seq_sum pins for the per-pair path; masked-out windows contribute
-    exact +0.0 terms, which cannot change an f64 sum).
+    per-pair window segments — bit-identical floats: every f64
+    reduction compresses masked windows out and reduceats the same
+    element run the per-pair path's _seq_sum consumes (see
+    _segment_compressed_sums; zero-filling masked slots instead would
+    shift reduceat's pairwise block boundaries and drift a ulp).
 
     Returns (ani, af, frags_matching, frags_total) arrays, one entry
     per pair."""
@@ -553,8 +591,7 @@ def _directed_from_counts_arrays(
         aligned.astype(np.int64), starts)
 
     below = frag_ok & ~aligned
-    cnt_below = np.add.reduceat(below.astype(np.int64), starts)
-    sum_below = np.add.reduceat(np.where(below, c_w, 0.0), starts)
+    sum_below, cnt_below = _segment_compressed_sums(c_w, below, starts)
     r_est = np.where(cnt_below > 0,
                      sum_below / np.maximum(cnt_below, 1), 0.0)
 
@@ -562,8 +599,10 @@ def _directed_from_counts_arrays(
     r_w = np.repeat(r_est, seg_lens)
     c_adj = np.clip((c_w - r_w) / np.maximum(1.0 - r_w, 1e-9),
                     1e-12, 1.0)
-    identity = np.where(aligned, c_adj ** (1.0 / k), 0.0)
-    sum_id = np.add.reduceat(identity, starts)
+    # the power is elementwise (position-independent), so raising the
+    # full array then compressing matches the per-pair compressed pow
+    sum_id, _ = _segment_compressed_sums(c_adj ** (1.0 / k), aligned,
+                                         starts)
 
     has = frags_matching > 0
     ani = np.where(has, sum_id / np.maximum(frags_matching, 1), 0.0)
@@ -735,6 +774,57 @@ def _directed_ani_arrays_c(
     return out_ani, out_af, out_fm, out_ft
 
 
+# Fragment-ANI membership strategies (GALAH_TPU_FRAGMENT_STRATEGY to
+# pin; unset/"auto" resolves per backend):
+#   pallas — ops/pallas_fragment.py's blocked multi-pair Mosaic kernel
+#            (interpret-mode on non-TPU backends, so parity tests can
+#            pin it on CPU)
+#   xla    — the vmapped searchsorted dispatch path
+#   c      — csrc/pairstats.c's merge membership counter (host)
+FRAGMENT_STRATEGIES = ("pallas", "xla", "c")
+
+
+def _c_merge_available() -> bool:
+    try:
+        from galah_tpu.ops import _cpairstats
+    except Exception:  # pragma: no cover - import error == no C
+        return False
+    return hasattr(_cpairstats, "window_match_counts_merge")
+
+
+def _resolve_fragment_strategy(
+    backend: "Optional[str]" = None,
+    n_devices: "Optional[int]" = None,
+    c_ok: "Optional[bool]" = None,
+) -> "Tuple[str, bool]":
+    """(strategy, explicit) for the exact-ANI membership stage.
+
+    An explicit GALAH_TPU_FRAGMENT_STRATEGY pin always wins (and its
+    failures propagate — parity runs must never silently compare a
+    fallback to itself). AUTO mirrors the historical defaults: the
+    single-core C merge on a single-device CPU runtime (it beat the
+    XLA-CPU searchsorted by avoiding padding entirely), the Mosaic
+    kernel on a real TPU backend, the vmapped XLA path everywhere
+    else (notably multi-device CPU meshes, whose sharded batch path
+    the C merge cannot use). The injectable parameters exist for
+    selection tests; production callers pass nothing.
+    """
+    env = (os.environ.get("GALAH_TPU_FRAGMENT_STRATEGY") or "").lower()
+    if env in FRAGMENT_STRATEGIES:
+        return env, True
+    backend = jax.default_backend() if backend is None else backend
+    n_devices = jax.device_count() if n_devices is None else n_devices
+    if c_ok is None:
+        c_ok = _c_merge_available()
+    if backend == "cpu" and n_devices == 1 and c_ok:
+        return "c", False
+    from galah_tpu.ops.hll import use_pallas_default
+
+    if backend == "tpu" and use_pallas_default():
+        return "pallas", False
+    return "xla", False
+
+
 def directed_ani_batch(
     queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
     identity_floor: float = 0.80,
@@ -744,94 +834,134 @@ def directed_ani_batch(
     """Directed fragment ANI for many (query, ref) pairs, coalescing
     device dispatches.
 
-    Queries are grouped by their padded (W, L, H) shape bucket; each
-    bucket runs as vmapped dispatches of at most _BATCH_ELEM_CAP window
-    elements. Results are bit-identical to per-pair `directed_ani` (the
-    vmap computes the same per-row searchsorted); only the dispatch
-    granularity changes. This is the framework's answer to the
-    reference's one-subprocess-per-pair fastANI calls (reference:
-    src/fastani.rs:88-105) — and the reason the engine's backend
-    interface is batched (see backends/base.py).
+    The membership stage runs under the resolved fragment strategy
+    (see _resolve_fragment_strategy): the C merge path consumes cached
+    sorted queries with no padding; the XLA and Pallas paths group
+    queries by their padded (W, L, H) shape bucket so a handful of
+    kernel variants cover any genome collection. Results are
+    bit-identical across all three (the per-window integers are exact
+    and the f64 reduction is shared). This is the framework's answer
+    to the reference's one-subprocess-per-pair fastANI calls
+    (reference: src/fastani.rs:88-105) — and the reason the engine's
+    backend interface is batched (see backends/base.py).
     """
-    # Single-device CPU backend: the compiled-C merge membership
-    # counter (csrc/pairstats.c::galah_window_match_counts_merge —
-    # O(nq + H) per pair on the profile's cached sorted query, vs the
-    # matrix walker's O(slots * log H) binary searches) beats the
-    # XLA-CPU searchsorted dispatch per pair and needs no padding.
-    # Multi-device runtimes keep the sharded vmapped path.
-    if jax.default_backend() == "cpu" and jax.device_count() == 1:
-        try:
-            from galah_tpu.ops._cpairstats import (
-                window_match_counts_merge,
-            )
-        except ImportError:
-            window_match_counts_merge = None  # no C toolchain: JAX
-        if window_match_counts_merge is not None:
-            # Large pair lists (the dense-similarity regime can carry
-            # N^2/2 screened pairs) take the fully batched path: ONE
-            # threaded C call per chunk for the merges and vectorized
-            # host post-math — bit-identical DirectedANI floats to the
-            # per-pair loop below (see _directed_from_counts_arrays).
-            if _batch_path_worthwhile(queries):
-                uniform = len({(q.k, q.fraglen, q.subsample_c)
-                               for q, _ in queries}) == 1
-                if uniform:
-                    return _directed_ani_batch_c(
-                        queries, identity_floor, min_window_valid_frac,
-                        threads)
+    if not queries:
+        return []
+    strategy, explicit = _resolve_fragment_strategy()
+    timing.counter(f"fragment-strategy-{strategy}", 1)
+    if strategy == "c":
+        return _directed_ani_batch_cmerge(
+            queries, identity_floor, min_window_valid_frac, threads)
 
-            def one(pair):
-                q, r = pair
-                qh, qw, totals = q.sorted_query()
-                matched = window_match_counts_merge(
-                    qh, qw, q.n_windows, r.ref_set, validate=False)
-                return _directed_from_counts(
-                    matched, totals, q, identity_floor,
-                    min_window_valid_frac)
+    from galah_tpu.ops._fallback import run_with_pallas_fallback
 
-            if threads > 1 and len(queries) > 1:
-                # pairs are independent and the merge releases the GIL
-                # (ctypes) — honor the threads knob across pairs. Warm
-                # each unique query's sorted_query cache first so the
-                # first wave of threads doesn't build it redundantly
-                # (one candidate vs many refs is the common shape).
-                from galah_tpu.io.prefetch import _shared_pool
+    def run(pallas: bool) -> "list[DirectedANI]":
+        if pallas:
+            return _directed_ani_batch_pallas(
+                queries, identity_floor, min_window_valid_frac)
+        return _directed_ani_batch_xla(
+            queries, identity_floor, min_window_valid_frac)
 
-                for q in {id(q): q for q, _ in queries}.values():
-                    q.sorted_query()
-                # The shared pool is sized to the LARGEST worker count
-                # ever requested in-process; keep at most `threads`
-                # futures outstanding so a smaller knob here still
-                # bounds concurrency to what the user asked for, and
-                # refill on EACH completion (not in waves — pair costs
-                # are heterogeneous, one big query vs many small refs
-                # is the common shape).
-                from concurrent.futures import FIRST_COMPLETED, wait
+    res, used = run_with_pallas_fallback(
+        "fragment window-match kernel", explicit,
+        strategy == "pallas", run)
+    if strategy == "pallas" and not used:
+        timing.counter("fragment-pallas-demoted", 1)
+    return res
 
-                pool = _shared_pool(threads)
-                out: "list[Optional[DirectedANI]]" = [None] * len(queries)
-                it = iter(enumerate(queries))
-                pending = {}
 
-                def submit_next() -> bool:
-                    try:
-                        i, pair = next(it)
-                    except StopIteration:
-                        return False
-                    pending[pool.submit(one, pair)] = i
-                    return True
+def _directed_ani_batch_cmerge(
+    queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
+    identity_floor: float,
+    min_window_valid_frac: float,
+    threads: int,
+) -> "list[DirectedANI]":
+    """The compiled-C merge membership strategy (csrc/pairstats.c::
+    galah_window_match_counts_merge — O(nq + H) per pair on the
+    profile's cached sorted query, vs the matrix walker's
+    O(slots * log H) binary searches); a host path, no device work.
+    ImportError propagates: AUTO only resolves here when the extension
+    probe passed, so reaching it without the toolchain means an
+    explicit pin — which must fail loudly."""
+    from galah_tpu.ops._cpairstats import window_match_counts_merge
 
-                for _ in range(threads):
-                    if not submit_next():
-                        break
-                while pending:
-                    done, _ = wait(pending, return_when=FIRST_COMPLETED)
-                    for f in done:
-                        out[pending.pop(f)] = f.result()
-                        submit_next()
-                return out  # type: ignore[return-value]
-            return [one(pair) for pair in queries]
+    # Large pair lists (the dense-similarity regime can carry
+    # N^2/2 screened pairs) take the fully batched path: ONE
+    # threaded C call per chunk for the merges and vectorized
+    # host post-math — bit-identical DirectedANI floats to the
+    # per-pair loop below (see _directed_from_counts_arrays).
+    if _batch_path_worthwhile(queries):
+        uniform = len({(q.k, q.fraglen, q.subsample_c)
+                       for q, _ in queries}) == 1
+        if uniform:
+            return _directed_ani_batch_c(
+                queries, identity_floor, min_window_valid_frac,
+                threads)
 
+    def one(pair):
+        q, r = pair
+        qh, qw, totals = q.sorted_query()
+        matched = window_match_counts_merge(
+            qh, qw, q.n_windows, r.ref_set, validate=False)
+        return _directed_from_counts(
+            matched, totals, q, identity_floor,
+            min_window_valid_frac)
+
+    if threads > 1 and len(queries) > 1:
+        # pairs are independent and the merge releases the GIL
+        # (ctypes) — honor the threads knob across pairs. Warm
+        # each unique query's sorted_query cache first so the
+        # first wave of threads doesn't build it redundantly
+        # (one candidate vs many refs is the common shape).
+        from galah_tpu.io.prefetch import _shared_pool
+
+        for q in {id(q): q for q, _ in queries}.values():
+            q.sorted_query()
+        # The shared pool is sized to the LARGEST worker count
+        # ever requested in-process; keep at most `threads`
+        # futures outstanding so a smaller knob here still
+        # bounds concurrency to what the user asked for, and
+        # refill on EACH completion (not in waves — pair costs
+        # are heterogeneous, one big query vs many small refs
+        # is the common shape).
+        from concurrent.futures import FIRST_COMPLETED, wait
+
+        pool = _shared_pool(threads)
+        out: "list[Optional[DirectedANI]]" = [None] * len(queries)
+        it = iter(enumerate(queries))
+        pending = {}
+
+        def submit_next() -> bool:
+            try:
+                i, pair = next(it)
+            except StopIteration:
+                return False
+            pending[pool.submit(one, pair)] = i
+            return True
+
+        for _ in range(threads):
+            if not submit_next():
+                break
+        while pending:
+            done, _ = wait(pending, return_when=FIRST_COMPLETED)
+            for f in done:
+                out[pending.pop(f)] = f.result()
+                submit_next()
+        return out  # type: ignore[return-value]
+    return [one(pair) for pair in queries]
+
+
+def _directed_ani_batch_xla(
+    queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
+    identity_floor: float,
+    min_window_valid_frac: float,
+) -> "list[DirectedANI]":
+    """The vmapped-searchsorted strategy: queries grouped by padded
+    shape bucket, each bucket dispatched in chunks of at most
+    _BATCH_ELEM_CAP window elements (multi-device runtimes shard the
+    batch dim over the host-local mesh). Bit-identical to per-pair
+    `directed_ani` — the vmap computes the same per-row searchsorted;
+    only the dispatch granularity changes."""
     out: "list[Optional[DirectedANI]]" = [None] * len(queries)
     groups: "dict[tuple, list[int]]" = {}
     for n, (q, r) in enumerate(queries):
@@ -874,6 +1004,71 @@ def directed_ani_batch(
                 out[n] = _directed_from_counts(
                     np.asarray(m), np.asarray(t), queries[n][0],
                     identity_floor, min_window_valid_frac)
+    return out  # type: ignore[return-value]
+
+
+def _directed_ani_batch_pallas(
+    queries: "list[Tuple[GenomeProfile, GenomeProfile]]",
+    identity_floor: float,
+    min_window_valid_frac: float,
+) -> "list[DirectedANI]":
+    """The blocked Mosaic strategy (ops/pallas_fragment.py): queries
+    grouped by padded shape bucket like the XLA path — the kernel's
+    launch packer then covers each bucket's pairs with as few grid
+    launches as its volume caps allow. Per-ELEMENT membership flags
+    come back host-side; one bincount per pair folds them into the
+    same per-window matched counts the other strategies produce, and
+    the shared _directed_from_counts_arrays reduction keeps the
+    DirectedANI floats bit-identical."""
+    from galah_tpu.ops import pallas_fragment
+
+    # interpret-mode on non-TPU backends: parity tests pin the
+    # strategy on CPU; a real TPU lowers through Mosaic
+    interpret = jax.default_backend() != "tpu"
+    out: "list[Optional[DirectedANI]]" = [None] * len(queries)
+    groups: "dict[tuple, list[int]]" = {}
+    for n, (q, r) in enumerate(queries):
+        key = (q.padded_windows().shape, r.padded_ref_set().shape[0],
+               q.k, q.fraglen, q.subsample_c)
+        groups.setdefault(key, []).append(n)
+
+    for (_w, _h, k, fraglen, subsample_c), idxs in groups.items():
+        items = []
+        for n in idxs:
+            q, r = queries[n]
+            items.append(
+                (q.sorted_query()[0], r.ref_set, r.padded_ref_set()))
+        hits = pallas_fragment.window_element_hits(
+            items, interpret=interpret)
+
+        matched_parts, total_parts, starts, live = [], [], [], []
+        seg = 0
+        for j, n in enumerate(idxs):
+            q, _r = queries[n]
+            _qh, qw, totals = q.sorted_query()
+            w = totals.shape[0]
+            if w == 0:
+                # reduceat cannot represent empty segments; the
+                # zero-window result is all-zero by definition
+                out[n] = DirectedANI(0.0, 0.0, 0, 0)
+                continue
+            matched = np.bincount(
+                qw[hits[j] != 0], minlength=w).astype(np.int32)
+            matched_parts.append(matched)
+            total_parts.append(totals)
+            starts.append(seg)
+            seg += w
+            live.append(n)
+        if not live:
+            continue
+        ani, af, fm, ft = _directed_from_counts_arrays(
+            np.concatenate(matched_parts),
+            np.concatenate(total_parts),
+            np.asarray(starts, dtype=np.int64), k, fraglen,
+            subsample_c, identity_floor, min_window_valid_frac)
+        for i, n in enumerate(live):
+            out[n] = DirectedANI(float(ani[i]), float(af[i]),
+                                 int(fm[i]), int(ft[i]))
     return out  # type: ignore[return-value]
 
 
@@ -968,18 +1163,17 @@ def bidirectional_ani_values(
                 est += (p.flat_hashes.shape[0]
                         // max(p.subsample_c, 1))
                 est += p.ref_set.shape[0]
+    # the boxing-free shortcut only exists for the C merge strategy;
+    # pallas/xla resolve to the fallback below, whose inner
+    # directed_ani_batch re-resolves and routes accordingly (AUTO only
+    # returns "c" when the extension probe passed; an explicit c pin
+    # without the toolchain fails loudly inside the arrays path)
+    strategy, _explicit = _resolve_fragment_strategy()
     use_arrays = (
-        jax.default_backend() == "cpu" and jax.device_count() == 1
+        strategy == "c"
         and 2 * n >= 64 and est <= _MERGE_BATCH_CONCAT_CAP
         and len({(p.k, p.fraglen, p.subsample_c)
                  for pair in pairs for p in pair}) == 1)
-    if use_arrays:
-        try:
-            from galah_tpu.ops._cpairstats import (  # noqa: F401
-                window_match_counts_merge_batch,
-            )
-        except ImportError:
-            use_arrays = False  # no C toolchain
     if not use_arrays:
         return [ani for ani, _, _ in bidirectional_ani_batch(
             pairs, min_aligned_frac, identity_floor=identity_floor,

@@ -76,6 +76,11 @@ run_stage lint 300 python -u -m galah_tpu.analysis --json
 run_stage test_tpu_hw 2400 env GALAH_RUN_SLOW=1 \
   python -u -m pytest tests/test_tpu_hw.py -q
 run_stage amortized 1800 python -u scripts/bench_amortized.py
+# Exact-stage strategy matrix next to the amortized capture: fragment
+# kernel pack sweep + xla/C baselines (pairlist's matrix runs inside
+# bench.py; this one also runs there, but a dedicated stage survives a
+# bench.py wedge and lands in its own artifact).
+run_stage fragment_variants 600 python -u scripts/bench_fragment_variants.py
 run_stage bench "$BENCH_TIMEOUT" env \
   GALAH_BENCH_STAGE_CAP=$((BENCH_TIMEOUT - 120)) python -u bench.py
 run_stage kernel_variants 1200 python -u scripts/bench_kernel_variants.py

@@ -44,6 +44,34 @@ def test_default_definition_matches_cli_flags():
     assert args.precluster_method == "skani"
 
 
+def test_missing_checkm_warning_emits_once_across_builds(caplog):
+    """Repeated clusterer construction (bench rungs, embedding tools)
+    emits the quality-ordering warning exactly once per process; later
+    constructions record warn-once-suppressed events instead
+    (reference: src/cluster_argument_parsing.rs:318 warns per call)."""
+    import logging
+
+    from galah_tpu.obs import events as obs_events
+    from galah_tpu.utils.logging import reset_warn_once
+
+    reset_warn_once()
+    obs_events.reset()
+    parser = argparse.ArgumentParser()
+    add_cluster_arguments(parser)
+    args = parser.parse_args([])  # no quality input -> warning path
+    with caplog.at_level(logging.WARNING, logger="galah_tpu.api"):
+        for _ in range(3):
+            generate_galah_clusterer(["x.fna"], vars(args))
+    hits = [r for r in caplog.records
+            if "Since CheckM input is missing" in r.getMessage()]
+    assert len(hits) == 1
+    suppressed = [e for e in obs_events.snapshot()
+                  if e["kind"] == "warn-once-suppressed"
+                  and "Since CheckM" in e["message"]]
+    assert len(suppressed) == 2
+    reset_warn_once()
+
+
 def test_conflicting_quality_inputs_raise():
     parser = argparse.ArgumentParser()
     add_cluster_arguments(parser)
