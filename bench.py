@@ -145,21 +145,32 @@ def run_sub(code, timeout):
         f"subprocess rc={proc.returncode}: {proc.stderr[-500:]}")
 
 
-def probe_backend(timeout=420, retry_timeout=90):
+def probe_backend(timeout=None, retry_timeout=None):
     """True iff a device backend comes up and multiplies in a subprocess.
 
-    The first attempt gets 420 s, matching tests/test_tpu_hw.py's probe
-    allowance — the bench must not give up on a tunnel the test harness
-    would still reach (a slow axon attach can take minutes after an
-    outage). The retry is short so a dead tunnel costs at most
-    timeout + retry_timeout before the honest CPU fallback."""
+    The first attempt defaults to GALAH_BENCH_PROBE_TIMEOUT (420 s,
+    matching tests/test_tpu_hw.py's probe allowance — the bench must
+    not give up on a tunnel the test harness would still reach; a slow
+    axon attach can take minutes after an outage). The retry gets a
+    quarter of that so a dead tunnel costs at most ~1.25x the budget
+    before the honest CPU fallback. The failure reason is a ONE-LINE
+    token (`probe-timeout after Ns` / `ExcType: first 200 chars`), not
+    a traceback — it lands verbatim in the BENCH errors array."""
+    from galah_tpu.config import env_value
+
+    if timeout is None:
+        timeout = float(env_value("GALAH_BENCH_PROBE_TIMEOUT"))
+    if retry_timeout is None:
+        retry_timeout = max(30.0, timeout / 4.0)
     last = None
     for t in (timeout, retry_timeout):
         try:
             run_sub(_PROBE_CODE, t)
             return True, None
+        except subprocess.TimeoutExpired:
+            last = f"probe-timeout after {t:.0f}s"
         except Exception as e:  # noqa: BLE001 - report, don't crash
-            last = f"{type(e).__name__}: {e}"
+            last = f"{type(e).__name__}: {str(e)[:200]}"
     return False, last
 
 
@@ -526,6 +537,46 @@ def _run_fragment_variants_stage(stages, errors, interpret=False):
         errors.append(f"fragment_variants: {type(e).__name__}: {e}")
 
 
+def _run_engine_rounds_stage(stages, errors):
+    """Host-vs-device greedy-selection throughput on the e2e_1000 rung
+    in a subprocess (scripts/bench_engine_rounds.py): the same planted-
+    family workload run once with GALAH_TPU_GREEDY_STRATEGY=host and
+    once with the round-based device fold, with a cluster-parity check
+    and the round/conflict/fallback counters in the payload. Same
+    isolation rationale as the variant matrices: self-budgeting script,
+    subprocess timeout."""
+    _ROUNDS_COST = 600
+    if not _admit(_ROUNDS_COST, "engine_rounds", errors):
+        return
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "scripts", "bench_engine_rounds.py"),
+             "--budget", str(_ROUNDS_COST - 30)],
+            capture_output=True, text=True,
+            timeout=_ROUNDS_COST, cwd=here)
+        data = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("ENGINE_ROUNDS_JSON "):
+                data = json.loads(line[len("ENGINE_ROUNDS_JSON "):])
+        if data is None:
+            raise RuntimeError(
+                f"rc={proc.returncode}: {proc.stderr[-400:]}")
+        stages["engine_rounds"] = data
+        # Flatten the verdict numbers (rates + round/conflict/fallback
+        # counters) to scalar stages so _finalize_obs mirrors them into
+        # run_report.json gauges alongside the ladder rungs.
+        for k in ("device_genomes_per_sec", "host_genomes_per_sec",
+                  "speedup"):
+            if isinstance(data.get(k), (int, float)):
+                stages[f"engine_rounds_{k}"] = data[k]
+        for k, v in (data.get("counters") or {}).items():
+            stages[f"engine_rounds_{k}"] = v
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"engine_rounds: {type(e).__name__}: {e}")
+
+
 def run_ladder_stages(stages, errors):
     """North-star-relevant e2e evidence in the driver artifact itself.
 
@@ -689,9 +740,11 @@ def main():
     if not ok:
         # TPU unreachable: report the honest CPU measurement instead of
         # a dead zero — the line stays parseable and the backend label +
-        # errors record that no TPU number was captured.
-        errors.append(f"backend probe failed: {err}")
+        # backend_reason record (in one line, not a traceback) that no
+        # TPU number was captured.
+        errors.append(f"backend=cpu-fallback reason={err}")
         result["backend"] = "cpu-fallback"
+        result["backend_reason"] = err
         cpu_prod = stages.get("cpu_production_pairs_per_sec")
         if cpu_prod:
             result["value"] = cpu_prod
@@ -728,6 +781,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             errors.append(f"cpu-pin: {type(e).__name__}: {e}")
         run_ladder_stages(stages, errors)
+        _run_engine_rounds_stage(stages, errors)
         # Strategy matrix still recorded (interpret mode) so a
         # no-tunnel capture is a documented negative, not a silence.
         _run_pairlist_variants_stage(stages, errors, interpret=True)
@@ -790,6 +844,7 @@ def main():
     # redundant kernel detail, not the verdict-relevant evidence (the
     # amortized campaign also runs standalone in the watcher).
     run_ladder_stages(stages, errors)
+    _run_engine_rounds_stage(stages, errors)
 
     # 4c. Amortized ON-CHIP kernel throughput (device-resident inputs,
     # fori_loop repeats inside one dispatch): the MFU measurement that

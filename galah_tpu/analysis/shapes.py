@@ -146,6 +146,21 @@ def _lattice() -> List[Tuple[str, str, Callable[[], object],
             sds((br, m), f32), sds((bc, m), f32))
     add("hll.hll_cardinality", "m=4096,uint8", hll_card,
         sds((4096,), jnp.uint8))
+
+    # greedy-selection window fold + membership argmax (f64 by
+    # contract — the NaN >= thr comparison must match the host's
+    # None-guarded float64 compare bit-for-bit); pow2 buckets from
+    # greedy_select._bucket, bool flags alongside
+    wsel = get("galah_tpu.ops.greedy_select", "_window_select_jit")
+    margmax = get("galah_tpu.ops.greedy_select", "_membership_argmax_jit")
+    f64, b8 = jnp.float64, jnp.bool_
+    for w in (8, 64):
+        add("greedy_select._window_select_jit", f"W={w},float64",
+            wsel, sds((w, w), f64), sds((w,), b8), sds((w,), b8),
+            sds((), f64))
+    for gg, rr in ((8, 8), (64, 16)):
+        add("greedy_select._membership_argmax_jit",
+            f"G={gg},R={rr},float64", margmax, sds((gg, rr), f64))
     return rows
 
 

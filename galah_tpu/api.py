@@ -61,6 +61,7 @@ class ClustererCommandDefinition:
     hash_algorithm: str = "hash-algorithm"
     ani_subsample: str = "ani-subsample"
     rep_scan_window: str = "rep-scan-window"
+    rep_rounds: str = "rep-rounds"
     checkm_tab_table: str = "checkm-tab-table"
     checkm2_quality_report: str = "checkm2-quality-report"
     genome_info: str = "genome-info"
@@ -147,6 +148,14 @@ def add_cluster_arguments(
                              "round trips, more speculative ANIs; the "
                              "waste is reported as the exact-ani-wasted "
                              "counter in the stage report")
+    parser.add_argument(f"--{d.rep_rounds}", type=int,
+                        default=None,
+                        help="Device greedy-selection round width: "
+                             "genomes speculatively taken per round of "
+                             "the round-based representative scan "
+                             "(default: 1024). Only the device strategy "
+                             "reads it; GALAH_TPU_GREEDY_STRATEGY pins "
+                             "device/host selection")
     parser.add_argument(f"--{d.threads}", "-t", type=int, default=1,
                         help="Host threads for FASTA stats/IO fan-out "
                              "and CPU-backend native sketching/"
@@ -185,6 +194,8 @@ class GalahClusterer:
     #: speculative rep-scan batch width (None = engine default); the
     #: waste it buys is reported as the exact-ani-wasted counter
     rep_scan_window: Optional[int] = None
+    #: device greedy-selection round width (None = engine default)
+    rep_rounds: Optional[int] = None
     #: genomes quarantined by the --on-bad-genome=skip preflight (None
     #: under the default error policy); the CLI writes this next to the
     #: outputs as quarantine.json
@@ -195,7 +206,8 @@ class GalahClusterer:
 
         return run(self.genome_paths, self.preclusterer, self.clusterer,
                    checkpoint=self.checkpoint,
-                   rep_scan_window=self.rep_scan_window)
+                   rep_scan_window=self.rep_scan_window,
+                   rep_rounds=self.rep_rounds)
 
 
 def _get(values: Dict, definition: ClustererCommandDefinition,
@@ -259,6 +271,11 @@ def generate_galah_clusterer(
     if rep_scan_window is not None and rep_scan_window < 1:
         raise ValueError(
             f"--{d.rep_scan_window} must be >= 1, got {rep_scan_window}")
+    raw_rounds = _get(values, d, d.rep_rounds)
+    rep_rounds = int(raw_rounds) if raw_rounds is not None else None
+    if rep_rounds is not None and rep_rounds < 1:
+        raise ValueError(
+            f"--{d.rep_rounds} must be >= 1, got {rep_rounds}")
 
     # Bad-input quarantine — BEFORE quality ordering, which already
     # reads every genome for stats: under 'skip' the unreadable ones
@@ -298,15 +315,17 @@ def generate_galah_clusterer(
             "Specify at most one of --checkm-tab-table, "
             "--checkm2-quality-report and --genome-info")
     if not given:
-        from galah_tpu.utils.logging import warn_once
+        from galah_tpu.obs.events import warn_once
 
         # Repeated construction (bench rungs, embedding tools) must not
         # repeat this once-per-run fact — BENCH_r05's tail carried one
-        # copy per invocation site.
+        # copy per in-process bench stage. The explicit key dedupes
+        # across every module that might phrase the same fact.
         warn_once(
             logger,
             "Since CheckM input is missing, genomes are not being ordered "
-            "by quality. Instead the order of their input is being used")
+            "by quality. Instead the order of their input is being used",
+            key="checkm-input-missing")
     else:
         kind, path = given[0]
         formula = _get(values, d, d.quality_formula) \
@@ -385,4 +404,5 @@ def generate_galah_clusterer(
     return GalahClusterer(genome_paths=genome_paths, preclusterer=pre,
                           clusterer=cl, backend_params=backend_params,
                           rep_scan_window=rep_scan_window,
+                          rep_rounds=rep_rounds,
                           quarantine=quarantine)

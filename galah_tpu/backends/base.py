@@ -67,3 +67,23 @@ class ClusterBackend(abc.ABC):
 
     def calculate_ani(self, f1: str, f2: str) -> Optional[float]:
         return self.calculate_ani_batch([(f1, f2)])[0]
+
+    def calculate_ani_batch_array(self, pairs: Sequence[tuple[str, str]]):
+        """The batch result as a float64 array, NaN where the backend
+        returned None (failed aligned-fraction gate).
+
+        This is the device-consumer form of the batch API: the engine's
+        round-based greedy selection (ops/greedy_select.py) feeds the
+        values straight into jitted decision passes, where NaN already
+        IS the no-edge encoding (an IEEE ``NaN >= thr`` compares False
+        exactly like the host's ``ani is not None`` guard), so no
+        None-boxing round trip is needed. Backends whose results are
+        already device-resident may override to skip the Python list
+        entirely; the default adapts :meth:`calculate_ani_batch`.
+        """
+        import numpy as np
+
+        anis = self.calculate_ani_batch(pairs)
+        return np.array(
+            [np.nan if a is None else float(a) for a in anis],
+            dtype=np.float64)

@@ -34,6 +34,7 @@ logger = logging.getLogger(__name__)
 _FINGERPRINT = "fingerprint.json"
 _DISTANCES = "precluster_distances.npz"
 _CLUSTERS = "clusters.jsonl"
+_GREEDY = "greedy_rounds.jsonl"
 
 
 def run_fingerprint(genomes: Sequence[str], precluster_method: str,
@@ -81,7 +82,8 @@ class ClusterCheckpoint:
                 logger.warning(
                     "Checkpoint at %s belongs to a different run "
                     "configuration; starting fresh", path)
-                for name in (_FINGERPRINT, _DISTANCES, _CLUSTERS):
+                for name in (_FINGERPRINT, _DISTANCES, _CLUSTERS,
+                             _GREEDY):
                     try:
                         os.unlink(os.path.join(path, name))
                     except FileNotFoundError:
@@ -108,6 +110,13 @@ class ClusterCheckpoint:
                 h.update(f.read())
         done = sorted(self.load_completed())
         h.update(json.dumps(done).encode())
+        # the greedy-round ANI log feeds the deterministic round replay
+        # on every host; uneven logs would desynchronize the sharded
+        # ANI exchange, so it is part of the all-or-nothing comparison
+        gn = os.path.join(self.path, _GREEDY)
+        if os.path.exists(gn):
+            with open(gn, "rb") as f:
+                h.update(f.read())
         return h.digest()
 
     def reset_state(self) -> None:
@@ -115,7 +124,7 @@ class ClusterCheckpoint:
         run recomputes from scratch on every host, symmetrically."""
         if not self.enabled:
             return
-        for name in (_DISTANCES, _CLUSTERS):
+        for name in (_DISTANCES, _CLUSTERS, _GREEDY):
             try:
                 os.unlink(os.path.join(self.path, name))
             except FileNotFoundError:
@@ -196,3 +205,66 @@ class ClusterCheckpoint:
                                 "clusters": clusters}) + "\n")
             f.flush()
             os.fsync(f.fileno())
+
+    # -- greedy phase, per-round (device strategy) --------------------
+    #
+    # The device strategy's rounds are deterministic given the ANI
+    # values, so round-granular resume stores ONLY the backend-computed
+    # (i, j, ani) triples each round produced — a persistent ANI cache,
+    # no decision state. A resume replays the values and re-derives
+    # every decision with zero dispatches up to the crash point. Each
+    # record is digest-bound to the pending-precluster sequence it was
+    # computed for (engine._greedy_digest); stale records are ignored.
+
+    def load_greedy_rounds(
+            self, digest: str) -> List[tuple]:
+        """All (i, j, ani-or-None) triples recorded for `digest`."""
+        out: List[tuple] = []
+        if not self.enabled:
+            return out
+        fn = os.path.join(self.path, _GREEDY)
+        if not os.path.exists(fn):
+            return out
+        with open(fn) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # torn tail from a kill mid-write: that round just
+                    # recomputes its pairs
+                    logger.warning(
+                        "Dropping torn greedy-round record in %s", fn)
+                    continue
+                if rec.get("digest") != digest:
+                    continue
+                for i, j, ani in rec["pairs"]:
+                    out.append((int(i), int(j),
+                                float(ani) if ani is not None else None))
+        if out:
+            logger.info("Resuming: replaying %d greedy-round ANI pairs",
+                        len(out))
+        return out
+
+    def save_greedy_round(self, digest: str,
+                          pairs: List[tuple]) -> None:
+        if not self.enabled:
+            return
+        rec = {"digest": digest,
+               "pairs": [[i, j, ani] for i, j, ani in pairs]}
+        with open(os.path.join(self.path, _GREEDY), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def clear_greedy_rounds(self) -> None:
+        """Drop the round log once its preclusters have all been saved
+        to the clusters log (the durable form)."""
+        if not self.enabled:
+            return
+        try:
+            os.unlink(os.path.join(self.path, _GREEDY))
+        except FileNotFoundError:
+            pass

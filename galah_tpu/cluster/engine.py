@@ -26,7 +26,8 @@ Semantics preserved:
 from __future__ import annotations
 
 import logging
-from typing import TYPE_CHECKING, List, Optional, Sequence, Set, Tuple
+from typing import (TYPE_CHECKING, Dict, List, Optional, Sequence, Set,
+                    Tuple)
 
 if TYPE_CHECKING:
     from galah_tpu.cluster.checkpoint import ClusterCheckpoint
@@ -41,6 +42,27 @@ logger = logging.getLogger(__name__)
 
 DENSE_PRECLUSTER_CAP = 64
 
+# Materialization sub-rounds per device-strategy window: each sub-round
+# batches one new frontier rep per precluster segment against its later
+# window neighbors, so this bounds the rep-chain depth a window resolves
+# on device; deeper windows are conflict windows and finish on the
+# host-order scan (greedy_select.FOLD_ITERS is kept at 2x this).
+MAX_SUBROUNDS = 16
+
+# Unique-genome cap per device-strategy backend dispatch: bounds the
+# profile heap one chunk pins at once (see the batch() closure in
+# _cluster_pending_rounds). Matches DENSE_PRECLUSTER_CAP, and stays
+# under the ProfileStore's default LRU bound (128) so a chunk never
+# thrashes its own working set.
+ROUND_BATCH_GENOMES = 64
+
+# Host-strategy speculative rep-scan batch width: genomes per window
+# evaluated against all current reps in one backend call. Configurable
+# via cluster(rep_scan_window=...) / --rep-scan-window; the waste it
+# buys (ANIs computed but never consulted by a decision) is measured
+# per run as the exact-ani-wasted counter in the stage report.
+REP_SCAN_WINDOW = 128
+
 
 def cluster(
     genomes: Sequence[str],
@@ -49,6 +71,7 @@ def cluster(
     checkpoint: Optional["ClusterCheckpoint"] = None,
     dense_precluster_cap: int = DENSE_PRECLUSTER_CAP,
     rep_scan_window: Optional[int] = None,
+    rep_rounds: Optional[int] = None,
 ) -> List[List[int]]:
     """Cluster quality-ordered genome paths -> list of index clusters.
 
@@ -72,13 +95,24 @@ def cluster(
 
     Waste is measured, not assumed: the exact-ani-computed /
     exact-ani-wasted counters in the stage report count backend-computed
-    pairs never read by any decision. On the 18-MAG abisko campaign
-    (2026-07-30, fast mode, 99% ANI) the windowed path computed 62 ANIs
+    pairs never read by any decision (exact-ani-wasted-rep /
+    -membership / -warm split the total by the phase that paid for the
+    speculation). On the 18-MAG abisko campaign (2026-07-30, fast mode,
+    99% ANI) the windowed path computed 62 ANIs
     with 0 wasted — the membership argmax consults every (non-rep, rep)
     pair, consuming the speculation — while the dense-warm path computed
     153 with 91 unconsulted (59%), the price of one-dispatch-per-
     precluster. `rep_scan_window` (CLI --rep-scan-window) tunes the
     speculative width; tests/test_campaign_abisko18.py bounds the waste.
+
+    Strategy: GALAH_TPU_GREEDY_STRATEGY pins the greedy scan to the
+    round-based `device` path (K-genome rounds across ALL pending
+    preclusters, one batched dispatch per round, jitted segmented
+    selection — ops/greedy_select.py, `rep_rounds` / --rep-rounds sets
+    K) or the per-precluster `host` scan; unset AUTO runs the device
+    path and demotes to the host scan on failure
+    (greedy-device-demoted). Decisions are bit-identical either way
+    (docs/cluster_engine.md).
     """
     skip_clusterer = preclusterer.method_name() == clusterer.method_name()
     if skip_clusterer:
@@ -105,6 +139,38 @@ def cluster(
         "Finding representative genomes and assigning all genomes ..")
     all_clusters: List[List[int]] = []
     with timing.stage("greedy-cluster"):
+        from galah_tpu.ops.greedy_select import resolve_greedy_strategy
+
+        strategy, explicit = resolve_greedy_strategy()
+        timing.counter(f"greedy-strategy-{strategy}", 1)
+        pending = [(i, m) for i, m in enumerate(preclusters)
+                   if i not in done]
+        if strategy == "device" and pending:
+            try:
+                device_done = _cluster_pending_rounds(
+                    clusterer, genomes, pre_cache, pending,
+                    skip_clusterer, checkpoint, rep_rounds)
+            except Exception as e:  # noqa: BLE001 - AUTO demotes
+                if explicit:
+                    raise
+                logger.warning(
+                    "device greedy selection failed (%s: %s); falling "
+                    "back to the host scan", type(e).__name__, e)
+                timing.counter("greedy-device-demoted", 1)
+                from galah_tpu.obs import events
+
+                events.record("greedy-demoted",
+                              error=f"{type(e).__name__}: {e}")
+                device_done = None
+            if device_done is not None:
+                for pc_index, global_clusters in sorted(
+                        device_done.items()):
+                    if checkpoint:
+                        checkpoint.save_precluster(
+                            pc_index, global_clusters)
+                    done[pc_index] = global_clusters
+                if checkpoint:
+                    checkpoint.clear_greedy_rounds()
         for pc_index, members in enumerate(preclusters):
             if pc_index in done:
                 all_clusters.extend(done[pc_index])
@@ -119,6 +185,7 @@ def cluster(
             reps, ani_cache, computed, consulted = _find_representatives(
                 clusterer, local_cache, local_genomes, skip_clusterer,
                 warm_cache, rep_scan_window)
+            n_rep_computed = len(computed)
             local_clusters = _find_memberships(
                 clusterer, reps, local_cache, local_genomes, ani_cache,
                 skip_clusterer, warm_cache, computed, consulted)
@@ -128,30 +195,20 @@ def cluster(
             # upfront dense-warm pass. The reference has the same waste
             # class via find_any computing an unpredictable candidate
             # subset (reference: src/clusterer.rs:242-262); here it is
-            # measured and reported in the stage report.
-            computed_keys = {pair_key(*p) for p in computed}
-            if warm_cache is not None:
-                computed_keys |= set(warm_cache.keys())
-            wasted = len(computed_keys - consulted)
-            timing.counter("exact-ani-computed", len(computed_keys))
-            timing.counter("exact-ani-wasted", wasted)
-            from galah_tpu.obs import metrics as obs_metrics
-
-            obs_metrics.counter(
-                "ani.exact_computed",
-                help="Exact ANI pairs the backend computed",
-                unit="pairs").inc(len(computed_keys))
-            obs_metrics.counter(
-                "ani.exact_wasted",
-                help="Backend-computed ANI pairs no greedy decision "
-                     "ever consulted (speculation waste)",
-                unit="pairs").inc(wasted)
-            if computed_keys:
-                logger.debug(
-                    "precluster %d: %d exact ANIs computed, %d never "
-                    "consulted (%.1f%% waste)", pc_index,
-                    len(computed_keys), wasted,
-                    100.0 * wasted / len(computed_keys))
+            # measured and reported in the stage report, split by the
+            # phase that paid for each pair.
+            rep_keys = {pair_key(*p) for p in computed[:n_rep_computed]}
+            mem_keys = {pair_key(*p)
+                        for p in computed[n_rep_computed:]} - rep_keys
+            warm_keys = (set(warm_cache.keys()) - rep_keys - mem_keys
+                         if warm_cache is not None else set())
+            computed_keys = rep_keys | mem_keys | warm_keys
+            _emit_waste_counters(
+                len(computed_keys),
+                rep=len(rep_keys - consulted),
+                membership=len(mem_keys - consulted),
+                warm=len(warm_keys - consulted),
+                label=f"precluster {pc_index}")
             global_clusters = [[members[i] for i in c]
                                for c in local_clusters]
             all_clusters.extend(global_clusters)
@@ -159,6 +216,34 @@ def cluster(
                 checkpoint.save_precluster(pc_index, global_clusters)
     logger.info("Found %d clusters", len(all_clusters))
     return all_clusters
+
+
+def _emit_waste_counters(n_computed: int, rep: int, membership: int,
+                         warm: int, label: str) -> None:
+    """Computed/wasted counters, the waste split by paying phase."""
+    wasted = rep + membership + warm
+    timing.counter("exact-ani-computed", n_computed)
+    timing.counter("exact-ani-wasted", wasted)
+    timing.counter("exact-ani-wasted-rep", rep)
+    timing.counter("exact-ani-wasted-membership", membership)
+    timing.counter("exact-ani-wasted-warm", warm)
+    from galah_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.counter(
+        "ani.exact_computed",
+        help="Exact ANI pairs the backend computed",
+        unit="pairs").inc(n_computed)
+    obs_metrics.counter(
+        "ani.exact_wasted",
+        help="Backend-computed ANI pairs no greedy decision "
+             "ever consulted (speculation waste)",
+        unit="pairs").inc(wasted)
+    if n_computed:
+        logger.debug(
+            "%s: %d exact ANIs computed, %d never consulted "
+            "(%.1f%% waste; rep %d / membership %d / warm %d)",
+            label, n_computed, wasted, 100.0 * wasted / n_computed,
+            rep, membership, warm)
 
 
 def _backend_ani_batch(
@@ -288,12 +373,392 @@ def _warm_all_hit_pairs(
     return warm
 
 
-# Speculative rep-scan batch width: genomes per window evaluated
-# against all current reps in one backend call. Configurable via
-# cluster(rep_scan_window=...) / --rep-scan-window; the waste it buys
-# (ANIs computed but never consulted by a decision) is measured per
-# run as the exact-ani-wasted counter in the stage report.
-REP_SCAN_WINDOW = 128
+def _greedy_digest(pending: List[Tuple[int, Sequence[int]]]) -> str:
+    """Digest of the pending-precluster sequence a greedy-round
+    checkpoint is valid for. A resume whose pending set differs (more
+    preclusters finished, different partition, different genome list —
+    the run fingerprint guards the rest) drops the round records
+    instead of replaying pairs into a differently-shaped scan."""
+    import hashlib
+    import json
+
+    ident = json.dumps([[pc, list(m)] for pc, m in pending])
+    return hashlib.sha256(ident.encode()).hexdigest()
+
+
+def _cluster_pending_rounds(
+    clusterer: ClusterBackend,
+    genomes: Sequence[str],
+    pre_cache: PairDistanceCache,
+    pending: List[Tuple[int, Sequence[int]]],
+    skip_clusterer: bool,
+    checkpoint: Optional["ClusterCheckpoint"],
+    rep_rounds: Optional[int],
+) -> Dict[int, List[List[int]]]:
+    """The round-based device greedy strategy over ALL pending
+    preclusters at once: {precluster index -> its global clusters}.
+
+    Each round takes the next K genomes of the concatenated pending
+    sequence (partition order; within a precluster that IS quality
+    order), evaluates their ANIs against every existing same-precluster
+    rep in one batched dispatch, materializes the intra-window hit
+    pairs that decisions need (one small frontier dispatch per
+    sub-round, all segments batched together), and resolves the
+    window's rep/member status with ONE jitted segmented fold
+    (ops/greedy_select.window_select). Decisions are bit-identical to
+    the per-precluster host scan; windows whose rep-chain depth
+    exceeds the sub-round/fold budget are conflict windows and finish
+    on the exact host-order scan (rare, measured:
+    greedy-conflict-windows / greedy-host-fallback-windows).
+
+    The win over the host path is dispatch count: the 1000-genome
+    bench rung runs ~250 preclusters, which the host path walks one at
+    a time (>=1 profile build + ANI dispatch each); here every round
+    spans all of them, so dispatches drop to O(N / K) and the backend's
+    batched profile build touches each genome group once.
+
+    With a checkpoint, each round's backend-computed pairs append to
+    greedy_rounds.jsonl (digest-bound to the pending sequence): a
+    resume replays the values into the cache and re-derives every
+    decision with zero dispatches up to the crash point.
+    """
+    import numpy as np
+
+    from galah_tpu.obs import metrics as obs_metrics
+    from galah_tpu.ops import greedy_select
+
+    thr = clusterer.ani_threshold
+    width = (int(rep_rounds) if rep_rounds is not None
+             else greedy_select.DEFAULT_ROUND_WIDTH)
+    if width < 1:
+        raise ValueError(f"rep_rounds must be >= 1, got {width}")
+
+    seq: List[int] = []
+    pc_of: Dict[int, int] = {}
+    for pc, members in pending:
+        for g in members:
+            seq.append(g)
+            pc_of[g] = pc
+    # precluster-hit adjacency restricted to pending genomes: the hit
+    # graph's components ARE the preclusters, so any key with one
+    # pending endpoint has both in the same pending precluster
+    adj: Dict[int, List[int]] = {g: [] for g in seq}
+    for a, b in pre_cache.keys():
+        if a in pc_of:
+            adj[a].append(b)
+            adj[b].append(a)
+    for v in adj.values():
+        v.sort()
+
+    ani_cache = PairDistanceCache()
+    computed: List[Tuple[int, int]] = []   # pairs that hit the backend
+    consulted: Set[Tuple[int, int]] = set()  # pairs a rep decision read
+    reps_by_pc: Dict[int, List[int]] = {pc: [] for pc, _ in pending}
+    rep_set: Set[int] = set()
+
+    digest = _greedy_digest(pending)
+    if checkpoint:
+        for i, j, ani in checkpoint.load_greedy_rounds(digest):
+            ani_cache.insert((i, j), ani)
+            computed.append((i, j))
+    n_replayed = len(computed)
+
+    def batch(pairs: List[Tuple[int, int]]) -> None:
+        """Compute pairs missing from the cache, chunked so no single
+        dispatch pins more than ROUND_BATCH_GENOMES genome profiles at
+        once. One monolithic batch would keep the whole window's
+        profile heap resident together (~1 MB/genome), and that
+        allocator pressure measurably slows the per-pair host merges
+        (~2x on the 1000-genome rung) — the pair lists arrive grouped
+        by precluster segment, so capping the working set keeps each
+        chunk's profiles cache-warm and lets the profile store's LRU
+        evict between chunks. Chunking preserves pair order, so the
+        computed log and every ANI value are bit-identical."""
+        seen: Set[Tuple[int, int]] = set()
+        uniq: List[Tuple[int, int]] = []
+        for p in pairs:
+            k = pair_key(*p)
+            if k in seen or ani_cache.contains(p):
+                continue
+            seen.add(k)
+            uniq.append(p)
+        chunk: List[Tuple[int, int]] = []
+        chunk_genomes: Set[int] = set()
+
+        def flush() -> None:
+            if not chunk:
+                return
+            anis = _batch_ani(clusterer, skip_clusterer, pre_cache,
+                              genomes, chunk, None,
+                              computed_log=computed)
+            for p, ani in zip(chunk, anis):
+                ani_cache.insert(p, ani)
+            chunk.clear()
+            chunk_genomes.clear()
+
+        for p in uniq:
+            if chunk and len(chunk_genomes | set(p)) > \
+                    ROUND_BATCH_GENOMES:
+                flush()
+            chunk.append(p)
+            chunk_genomes.update(p)
+        flush()
+
+    def value(i: int, j: int) -> Optional[float]:
+        """The decision value for a hit pair, same precedence as
+        _batch_ani: precluster reuse when methods match, else the
+        computed exact ANI (None when absent or gated)."""
+        if skip_clusterer and pre_cache.contains((i, j)):
+            return pre_cache.get((i, j))
+        return ani_cache.get((i, j))
+
+    hist = obs_metrics.histogram(
+        "greedy.round_seconds",
+        help="Wall-clock of one device-strategy selection round "
+             "(speculative dispatch + frontier sub-rounds + jitted "
+             "window fold)",
+        unit="s")
+    rounds_c = obs_metrics.counter(
+        "greedy.rounds",
+        help="Device-strategy selection rounds run", unit="rounds")
+    conflicts_c = obs_metrics.counter(
+        "greedy.conflict_windows",
+        help="Round windows whose rep-chain depth exceeded the device "
+             "resolution budget", unit="windows")
+    fallback_c = obs_metrics.counter(
+        "greedy.fallback_windows",
+        help="Round windows finished by the exact host-order scan",
+        unit="windows")
+
+    n = len(seq)
+    pos = 0
+    while pos < n:
+        window = seq[pos:pos + width]
+        pos += len(window)
+        with hist.time():
+            rstart = len(computed)
+            _device_round(window, pc_of, adj, reps_by_pc, rep_set,
+                          batch, value, consulted, thr, greedy_select,
+                          np, conflicts_c, fallback_c)
+            timing.counter("greedy-rounds", 1)
+            rounds_c.inc()
+            if checkpoint and len(computed) > rstart:
+                checkpoint.save_greedy_round(
+                    digest,
+                    [(i, j, ani_cache.get((i, j)))
+                     for i, j in computed[rstart:]])
+
+    # -- membership: one global batched dispatch + jitted argmax ------
+    todo: List[Tuple[int, int]] = []
+    for a, b in pre_cache.keys():
+        if a not in pc_of:
+            continue
+        a_rep, b_rep = a in rep_set, b in rep_set
+        if a_rep == b_rep:
+            continue  # rep-rep / non-rep pairs never decide membership
+        # orient (rep, non-rep); the (genome, rep)-ascending sort below
+        # keeps the host scan's deterministic batch order
+        r, i = (a, b) if a_rep else (b, a)
+        if not (skip_clusterer and pre_cache.contains((i, r))) \
+                and not ani_cache.contains((i, r)):
+            todo.append((r, i))
+    todo.sort(key=lambda p: (p[1], p[0]))
+    n_rep_computed = len(computed)
+    batch(todo)
+
+    results: Dict[int, List[List[int]]] = {}
+    for pc, members in pending:
+        rep_list = reps_by_pc[pc]
+        rep_col = {r: c for c, r in enumerate(rep_list)}
+        nonreps = [g for g in members if g not in rep_set]
+        clusters: List[List[int]] = [[r] for r in rep_list]
+        if nonreps:
+            mat = np.full((len(nonreps), len(rep_list)), np.nan,
+                          dtype=np.float64)
+            for gi, g in enumerate(nonreps):
+                for r in adj[g]:
+                    c = rep_col.get(r)
+                    if c is None:
+                        continue
+                    v = value(g, r)
+                    if v is not None:
+                        mat[gi, c] = v
+            best, has = greedy_select.membership_argmax(mat)
+            for gi, g in enumerate(nonreps):
+                if not has[gi]:
+                    raise RuntimeError(
+                        f"genome {genomes[g]} passed the representative "
+                        "test but has no ANI to any representative — "
+                        "inconsistent backend")
+                clusters[int(best[gi])].append(g)
+        results[pc] = clusters
+
+    # -- waste accounting, split by paying phase ----------------------
+    # the membership argmax consults every cached (non-rep, rep) pair,
+    # so any computed key joining a rep and a non-rep was consumed
+    computed_keys = {pair_key(*p) for p in computed}
+    mem_consulted = {k for k in computed_keys
+                     if (k[0] in rep_set) != (k[1] in rep_set)}
+    live = consulted | mem_consulted
+    rep_keys = {pair_key(*p) for p in computed[:n_rep_computed]}
+    mem_keys = {pair_key(*p) for p in computed[n_rep_computed:]} \
+        - rep_keys
+    _emit_waste_counters(
+        len(computed_keys),
+        rep=len(rep_keys - live),
+        membership=len(mem_keys - live),
+        warm=0,
+        label=f"device rounds ({len(pending)} preclusters)")
+    if n_replayed:
+        timing.counter("greedy-replayed-pairs", n_replayed)
+    return results
+
+
+def _device_round(
+    window: List[int],
+    pc_of: Dict[int, int],
+    adj: Dict[int, List[int]],
+    reps_by_pc: Dict[int, List[int]],
+    rep_set: Set[int],
+    batch,
+    value,
+    consulted: Set[Tuple[int, int]],
+    thr: float,
+    greedy_select,
+    np,
+    conflicts_c,
+    fallback_c,
+) -> None:
+    """Resolve one K-genome window; commits new reps into reps_by_pc.
+
+    Three phases, mirroring the docstring of _cluster_pending_rounds:
+    (1) one speculative batch of window x existing-rep hit pairs and
+    the derived already-clustered flags; (2) bounded frontier
+    sub-rounds that materialize exactly the intra-window pairs greedy
+    decisions depend on (the first undecided genome of every segment
+    is provably the next rep — all its earlier neighbors are decided
+    and none claimed it); (3) the jitted segmented fold over the
+    materialized matrix as the authoritative device decision pass,
+    cross-checked against the sub-round bookkeeping. Windows the
+    budget cannot finish fall back to the host-order scan for their
+    undecided tail — decisions stay exact, only the dispatch pattern
+    degrades.
+    """
+    w = len(window)
+    win_pos = {g: wi for wi, g in enumerate(window)}
+    hits = {g: set(adj[g]) for g in window}
+
+    # (1) window x existing same-precluster reps, ONE dispatch, then
+    # the already-clustered flags. The batched decision reads the whole
+    # candidate row (no early exit to skip pairs the batch computed
+    # anyway), so every candidate pair counts as consulted.
+    batch([(r, g) for g in window for r in reps_by_pc[pc_of[g]]
+           if r in hits[g]])
+    ext = np.zeros(w, dtype=bool)
+    for wi, g in enumerate(window):
+        for r in reps_by_pc[pc_of[g]]:
+            if r not in hits[g]:
+                continue
+            consulted.add(pair_key(r, g))
+            v = value(r, g)
+            if v is not None and v >= thr:
+                ext[wi] = True
+
+    # (2) frontier sub-rounds. The first undecided genome of a segment
+    # is exactly the next greedy rep: every earlier same-precluster
+    # genome is decided and none of the decided reps claimed it (prior
+    # rounds via ext, in-window reps via earlier claim applications).
+    # Each sub-round batches ALL segments' frontier-vs-later-hit pairs
+    # into one dispatch and applies the claims.
+    decided = ext.copy()
+    tentative = np.zeros(w, dtype=bool)
+    n_sub = 0
+    for _ in range(MAX_SUBROUNDS):
+        frontier: List[int] = []
+        seen_seg: Set[int] = set()
+        for wi in range(w):
+            if decided[wi]:
+                continue
+            s = pc_of[window[wi]]
+            if s in seen_seg:
+                continue
+            seen_seg.add(s)
+            frontier.append(wi)
+        if not frontier:
+            break
+        n_sub += 1
+        pairs: List[Tuple[int, int]] = []
+        claims: List[Tuple[int, int]] = []
+        for fi in frontier:
+            f = window[fi]
+            for t in adj[f]:
+                ti = win_pos.get(t)
+                if ti is None or ti <= fi or decided[ti]:
+                    continue
+                pairs.append((f, t))
+                claims.append((fi, ti))
+        batch(pairs)
+        for fi in frontier:
+            decided[fi] = True
+            tentative[fi] = True
+        for fi, ti in claims:
+            consulted.add(pair_key(window[fi], window[ti]))
+            v = value(window[fi], window[ti])
+            if v is not None and v >= thr:
+                decided[ti] = True
+    timing.counter("greedy-subrounds", n_sub)
+
+    # (3) the jitted fold over the materialized intra-window matrix.
+    # Soundness gate: the fold is only authoritative when bookkeeping
+    # is COMPLETE — over an incompletely materialized matrix, missing
+    # edges read as no-edge and a converged fold can still be wrong.
+    complete = bool(decided.all())
+    mat = np.full((w, w), np.nan, dtype=np.float64)
+    for wi, g in enumerate(window):
+        for t in adj[g]:
+            ti = win_pos.get(t)
+            if ti is None or ti <= wi:
+                continue
+            v = value(g, t)
+            if v is not None:
+                mat[wi, ti] = v
+    rep_flags, converged = greedy_select.window_select(mat, ext, thr)
+    if complete:
+        if not converged or not np.array_equal(rep_flags, tentative):
+            raise RuntimeError(
+                "device window fold disagreed with the exact sub-round "
+                "bookkeeping — refusing speculative greedy decisions")
+    else:
+        # conflict window: rep-chain depth exceeded the sub-round
+        # budget; finish the undecided tail with the exact host-order
+        # scan (small per-genome batches), decisions unchanged.
+        timing.counter("greedy-conflict-windows", 1)
+        conflicts_c.inc()
+        timing.counter("greedy-host-fallback-windows", 1)
+        fallback_c.inc()
+        for ti in range(w):
+            if decided[ti]:
+                continue
+            t = window[ti]
+            cands = [fi for fi in range(ti)
+                     if tentative[fi] and window[fi] in hits[t]]
+            batch([(window[fi], t) for fi in cands])
+            is_rep = True
+            for fi in cands:
+                consulted.add(pair_key(window[fi], t))
+                v = value(window[fi], t)
+                if v is not None and v >= thr:
+                    is_rep = False
+                    break
+            decided[ti] = True
+            if is_rep:
+                tentative[ti] = True
+
+    for wi in range(w):
+        if tentative[wi]:
+            g = window[wi]
+            reps_by_pc[pc_of[g]].append(g)
+            rep_set.add(g)
 
 
 def _find_representatives(
@@ -427,13 +892,21 @@ def _find_memberships(
     clusters: List[List[int]] = [[r] for r in rep_list]
 
     # Collect every (genome, rep) pair that still needs exact ANI.
+    # Candidates are by definition precluster hits, so ONE pass over
+    # the hit keys replaces the old O(non-reps x reps) double loop over
+    # contains() probes (hit graphs are sparse: at the 1000-genome
+    # bench rung this is ~2.7k keys vs ~560k probes); the (genome,
+    # rep)-ascending sort reproduces the old loop's batch order
+    # exactly, so dispatch contents are byte-identical.
     todo: List[Tuple[int, int]] = []
-    for i in range(len(genomes)):
-        if i in reps:
-            continue
-        for r in rep_list:
-            if not ani_cache.contains((i, r)) and pre_cache.contains((i, r)):
-                todo.append((r, i))
+    for a, b in pre_cache.keys():
+        a_rep, b_rep = a in reps, b in reps
+        if a_rep == b_rep:
+            continue  # rep-rep / non-rep pairs never decide membership
+        r, i = (a, b) if a_rep else (b, a)
+        if not ani_cache.contains((i, r)):
+            todo.append((r, i))
+    todo.sort(key=lambda p: (p[1], p[0]))
     anis = _batch_ani(clusterer, skip_clusterer, pre_cache, genomes, todo,
                       warm_cache, computed_log=computed)
     for (r, i), ani in zip(todo, anis):

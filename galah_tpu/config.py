@@ -141,6 +141,13 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
     Flag("GALAH_TPU_FRAGMENT_PAIRS", kind="int", section="kernel",
          help="Cap on genome pairs packed into one fragment-ANI "
               "Pallas launch; unset lets the job/volume caps decide"),
+    Flag("GALAH_TPU_GREEDY_STRATEGY", section="kernel",
+         choices=("device", "host"),
+         help="Pin the greedy representative scan to the round-based "
+              "device path or the per-precluster host scan instead of "
+              "the AUTO heuristic (decisions are bit-identical; a "
+              "pinned strategy's failures propagate instead of "
+              "demoting)"),
     Flag("GALAH_TPU_PALLAS_HASH", kind="bool", section="kernel",
          help="1 forces the quarantined Mosaic murmur3 kernel, 0 "
               "forces the XLA u64 emulation; unset uses the "
@@ -194,6 +201,12 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "TPU watcher derives it from BENCH_TIMEOUT"),
     Flag("GALAH_BENCH_N", kind="int", section="bench",
          help="Override the genome count of the bench.py ladder stage"),
+    Flag("GALAH_BENCH_PROBE_TIMEOUT", kind="float", default="420",
+         section="bench",
+         help="Seconds the bench.py backend probe may take before the "
+              "run records backend=cpu-fallback reason=probe-timeout "
+              "and pins JAX_PLATFORMS=cpu (the retry probe gets a "
+              "quarter of this)"),
     Flag("GALAH_RUN_SLOW", kind="bool", section="test",
          help="1 runs the slow/hardware test tier the default run "
               "skips"),

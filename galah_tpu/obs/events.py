@@ -13,14 +13,18 @@ artifact; perf_counter origins do not compare across processes).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional, Set, Tuple
 
 from galah_tpu.obs import trace as _trace
 
 _LOCK = threading.Lock()
 _EVENTS: List[dict] = []
+
+_WARN_ONCE_LOCK = threading.Lock()
+_WARNED: Set[Tuple[str, str]] = set()
 
 
 def record(kind: str, **fields) -> None:
@@ -40,3 +44,33 @@ def snapshot() -> List[dict]:
 def reset() -> None:
     with _LOCK:
         _EVENTS.clear()
+
+
+def warn_once(logger: logging.Logger, msg: str, *args,
+              key: Optional[str] = None) -> None:
+    """Emit `msg` at WARNING once per process, then suppress-and-count.
+
+    For warnings whose repetition carries no information — e.g. the
+    missing-CheckM-input notice fires once per clusterer construction,
+    which in bench/ladder runs means once per in-process rung. The
+    dedupe key is PROCESS-scoped: ``key`` when given (callers that
+    re-phrase the same fact, or that must dedupe across modules, pass a
+    stable identifier), else ``(logger.name, message)``. Suppressed
+    repeats still :func:`record` a ``warn-once-suppressed`` event so
+    the run report keeps the true multiplicity."""
+    dedupe = (key or logger.name, key or msg)
+    with _WARN_ONCE_LOCK:
+        first = dedupe not in _WARNED
+        if first:
+            _WARNED.add(dedupe)
+    if first:
+        logger.warning(msg, *args)
+    else:
+        record("warn-once-suppressed", logger=logger.name,
+               message=msg % args if args else msg)
+
+
+def reset_warn_once() -> None:
+    """Forget emitted warnings (tests)."""
+    with _WARN_ONCE_LOCK:
+        _WARNED.clear()

@@ -1,0 +1,194 @@
+"""Device-resident greedy representative selection (round windows).
+
+The engine's greedy scan (cluster/engine.py) decides, in quality order,
+whether each genome becomes a representative: genome ``i`` is a rep iff
+no earlier rep with a precluster hit has exact ANI >= threshold
+(reference: src/clusterer.rs:155-225). The scan itself is sequential,
+but its *decision state* is a tiny boolean lattice over a window of
+genomes — this module keeps that lattice on device:
+
+  * :func:`window_select` — one jitted segmented "peeling" fold over a
+    window's intra-window ANI matrix plus the already-clustered flags
+    from earlier rounds. Each fold iteration decides every genome whose
+    earlier same-precluster neighbors are all decided (the union-find-
+    style conflict resolution: a genome becomes a rep when no earlier
+    *rep* neighbor reaches the threshold, and joins a cluster when one
+    does). Segments never interact because cross-precluster entries of
+    the matrix are NaN (no edge) by construction. The fold is exact
+    greedy whenever it converges within the iteration budget; windows
+    with decision chains deeper than the budget are *conflict windows*
+    and the engine falls back to the host-order scan for them — rare,
+    and measured (greedy-conflict-windows / greedy-host-fallback-
+    windows counters).
+  * :func:`membership_argmax` — the membership phase's argmax over the
+    (non-rep x rep) candidate ANI matrix in the same jitted pass.
+    ``jnp.argmax`` returns the FIRST maximum, which with columns in
+    ascending rep order reproduces the host loop's strict-``>`` update
+    exactly: ties go to the lowest rep index.
+
+Bit-identity with the host scan relies on f64 end to end: inputs are
+float64 (x64 enabled at import, same contract as ops/pairwise.py), the
+threshold comparison is a single IEEE ``>=`` on the very same values
+the host path would compare, and NaN (missing / gated-to-None ANI)
+compares False exactly like the host's ``ani is not None`` guard.
+
+Shapes are padded to power-of-two buckets so a run compiles a handful
+of variants instead of one per window (GL3xx recompile-churn budget).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galah_tpu.utils import timing
+
+jax.config.update("jax_enable_x64", True)
+
+
+# Greedy representative-selection strategies (GALAH_TPU_GREEDY_STRATEGY
+# to pin; unset/"auto" resolves per backend):
+#   device — round-based batched selection: K-genome windows, one
+#            batched ANI dispatch per round, jitted segmented fold for
+#            the intra-window decisions (this module)
+#   host   — the per-genome windowed host scan (the pre-round engine)
+GREEDY_STRATEGIES = ("device", "host")
+
+#: Genomes speculatively taken per selection round (--rep-rounds).
+DEFAULT_ROUND_WIDTH = 1024
+
+#: Fold iterations before a window is declared a conflict window. Each
+#: iteration decides at least one genome per undecided chain, so this
+#: bounds the decision-dependency depth a window may carry; deeper
+#: chains (every genome waiting on the previous one's rep/non-rep
+#: status) fall back to the host-order scan, measured per window. Kept
+#: at 2x the engine's materialization budget (engine.MAX_SUBROUNDS):
+#: one rep emerges per sub-round per segment and each rep's members
+#: decide one fold iteration later, so depth <= 2 * sub-rounds.
+FOLD_ITERS = 32
+
+_MIN_BUCKET = 8
+
+
+def resolve_greedy_strategy() -> Tuple[str, bool]:
+    """(strategy, explicit) for the greedy representative scan.
+
+    An explicit GALAH_TPU_GREEDY_STRATEGY pin always wins and its
+    failures propagate (parity runs must never silently compare a
+    fallback to itself — same contract as _resolve_fragment_strategy).
+    AUTO resolves to the round-based device path everywhere: its
+    decisions are bit-identical to the host scan by construction and
+    it replaces O(preclusters + genomes/window) dispatches with
+    O(genomes/round), which pays on every backend; a failure inside
+    the device path demotes to the host scan for the run (the
+    greedy-device-demoted counter records it).
+    """
+    env = (os.environ.get("GALAH_TPU_GREEDY_STRATEGY") or "").lower()
+    if env in GREEDY_STRATEGIES:
+        return env, True
+    return "device", False
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+@jax.jit
+def _window_select_jit(ani: jax.Array, ext: jax.Array, valid: jax.Array,
+                       thr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Segmented greedy fold over one window.
+
+    ``ani``: (W, W) float64, strictly upper-triangular by construction —
+    ``ani[a, b]`` for a < b holds the exact ANI of the window's a-th and
+    b-th genomes when they share a precluster AND a precluster hit, NaN
+    otherwise (missing, gated-to-None, cross-segment, lower triangle).
+    ``ext``: (W,) bool — genome already claimed by a rep from an earlier
+    round. ``valid``: (W,) bool — padding mask. ``thr``: f64 scalar.
+
+    Returns ``(rep, undecided)``: rep flags for decided genomes and the
+    residual undecided mask (any True => the fold did not converge and
+    the caller must treat the window as a conflict window).
+    """
+    edges = ani >= thr  # NaN compares False, like the host's None guard
+    undecided = valid & ~ext
+    rep = jnp.zeros_like(undecided)
+
+    def body(_, carry):
+        rep, undecided = carry
+        # For column a: does any earlier (row) genome with an edge to a
+        # remain undecided / sit decided-as-rep?
+        earlier_und = jnp.any(edges & undecided[:, None], axis=0)
+        earlier_rep = jnp.any(edges & rep[:, None], axis=0)
+        new_rep = undecided & ~earlier_und & ~earlier_rep
+        new_member = undecided & earlier_rep
+        return rep | new_rep, undecided & ~new_rep & ~new_member
+
+    rep, undecided = jax.lax.fori_loop(0, FOLD_ITERS, body,
+                                       (rep, undecided))
+    return rep, undecided
+
+
+@jax.jit
+def _membership_argmax_jit(ani: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row argmax over the (non-rep x rep) candidate ANI matrix.
+
+    ``ani``: (G, R) float64, NaN where a (genome, rep) pair is not a
+    candidate (no precluster hit / ANI gated to None / column padding).
+    Returns ``(best, has)``: the first-maximum column per row (ties to
+    the lowest rep index, matching the host loop's strict-``>`` update)
+    and whether the row had any candidate at all.
+    """
+    scored = jnp.where(jnp.isnan(ani), -jnp.inf, ani)
+    best = jnp.argmax(scored, axis=1)
+    has = jnp.any(jnp.isfinite(scored), axis=1)
+    return best, has
+
+
+def window_select(ani: np.ndarray, ext: np.ndarray,
+                  thr: float) -> Tuple[np.ndarray, bool]:
+    """Host wrapper around :func:`_window_select_jit` with bucketing.
+
+    Pads to the next power-of-two window bucket (NaN matrix, False
+    flags — padded slots never decide anything), runs the fold, and
+    returns ``(rep_flags, converged)`` trimmed to the true width.
+    """
+    w = ani.shape[0]
+    b = _bucket(w)
+    mat = np.full((b, b), np.nan, dtype=np.float64)
+    mat[:w, :w] = ani
+    extp = np.zeros(b, dtype=bool)
+    extp[:w] = ext
+    validp = np.zeros(b, dtype=bool)
+    validp[:w] = True
+    timing.dispatch(1)
+    rep, undecided = _window_select_jit(
+        jnp.asarray(mat), jnp.asarray(extp), jnp.asarray(validp),
+        jnp.float64(thr))
+    rep = np.asarray(rep)[:w]
+    converged = not bool(np.asarray(undecided)[:w].any())
+    return rep, converged
+
+
+def membership_argmax(ani: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host wrapper around :func:`_membership_argmax_jit` with bucketing.
+
+    ``ani``: (G, R) float64 candidate matrix, NaN = not a candidate.
+    Returns ``(best, has)`` trimmed to the true (G,) width; rows
+    without any candidate carry ``has == False`` (the engine raises for
+    them, exactly like the host loop's no-candidate RuntimeError).
+    """
+    g, r = ani.shape
+    gb, rb = _bucket(g), _bucket(r)
+    mat = np.full((gb, rb), np.nan, dtype=np.float64)
+    if g and r:
+        mat[:g, :r] = ani
+    timing.dispatch(1)
+    best, has = _membership_argmax_jit(jnp.asarray(mat))
+    return np.asarray(best)[:g], np.asarray(has)[:g]

@@ -7,39 +7,22 @@ src/main.rs:17 and src/cluster_argument_parsing.rs:402.
 from __future__ import annotations
 
 import logging
-import threading
-from typing import Set, Tuple
-
-_WARN_ONCE_LOCK = threading.Lock()
-_WARNED: Set[Tuple[str, str]] = set()
 
 
-def warn_once(logger: logging.Logger, msg: str, *args) -> None:
-    """Emit `msg` at WARNING once per process per (logger, message).
+def warn_once(logger: logging.Logger, msg: str, *args,
+              key=None) -> None:
+    """Back-compat delegate: the canonical warn-once lives in
+    obs/events.py (process-scoped dedupe + suppressed-repeat events)."""
+    from galah_tpu.obs import events
 
-    For warnings whose repetition carries no information — e.g. the
-    missing-CheckM-input notice fires once per clusterer construction,
-    which in bench/ladder runs means once per rung. Repeats are still
-    counted as a structured event (obs/events.py) so the run report
-    records the suppressed multiplicity."""
-    key = (logger.name, msg)
-    with _WARN_ONCE_LOCK:
-        first = key not in _WARNED
-        if first:
-            _WARNED.add(key)
-    if first:
-        logger.warning(msg, *args)
-    else:
-        from galah_tpu.obs import events
-
-        events.record("warn-once-suppressed", logger=logger.name,
-                      message=msg % args if args else msg)
+    events.warn_once(logger, msg, *args, key=key)
 
 
 def reset_warn_once() -> None:
-    """Forget emitted warnings (tests)."""
-    with _WARN_ONCE_LOCK:
-        _WARNED.clear()
+    """Back-compat delegate (tests import it from here)."""
+    from galah_tpu.obs import events
+
+    events.reset_warn_once()
 
 
 def set_log_level(verbose: bool = False, quiet: bool = False) -> None:

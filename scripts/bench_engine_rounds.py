@@ -1,0 +1,135 @@
+"""Host-vs-device greedy-selection throughput on the e2e_1000 rung.
+
+The round-based device strategy (ops/greedy_select.py) replaces the
+host path's one-dispatch-group-per-precluster greedy scan with K-wide
+speculative rounds resolved in a jitted window fold. This stage prices
+exactly that trade on the SAME workload the bench ladder's e2e_1000
+rung runs (1000 synthetic genomes, 250 planted families x4, 3%
+mutation, 100 kbp, default finch+skani), end to end through
+``generate_galah_clusterer(...).cluster()``:
+
+  * device: GALAH_TPU_GREEDY_STRATEGY=device, run FIRST so its jit
+    compiles land inside its own timing (conservative for the speedup
+    claim — the host run inherits any shared backend-kernel compiles);
+  * host: GALAH_TPU_GREEDY_STRATEGY=host, the exact per-precluster
+    scan that produced the r05 ladder rate (65.3 genomes/s);
+  * parity: the two clusterings must be IDENTICAL (same nested index
+    lists, reps first) — a speedup over a different answer is a bug,
+    so a parity failure zeroes the speedup field and is reported.
+
+The payload carries the round/conflict/fallback counter deltas for the
+device run so a capture shows not just the rate but how the rounds
+went (how many windows fell back to the exact host-order scan).
+
+Self-budgeting like the variant matrices: under a tight --budget the
+workload downshifts to a 200-genome rung (recorded in `workload`), and
+a partial run still prints ENGINE_ROUNDS_JSON with what it measured.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_T0 = time.monotonic()
+
+# Device-round bookkeeping copied into the payload (deltas across the
+# timed device run).
+_COUNTERS = ("greedy-rounds", "greedy-subrounds",
+             "greedy-conflict-windows", "greedy-host-fallback-windows",
+             "greedy-replayed-pairs", "greedy-device-demoted")
+
+_VALUES = {"ani": 95.0, "precluster_ani": 90.0,
+           "min_aligned_fraction": 15.0, "fragment_length": 3000,
+           "precluster_method": "finch", "cluster_method": "skani",
+           "threads": 1}
+
+
+def _left(budget):
+    return budget - (time.monotonic() - _T0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=None,
+                    help="seconds for the whole stage (default 570, "
+                         "capped by GALAH_BENCH_STAGE_CAP)")
+    args = ap.parse_args()
+
+    budget = args.budget if args.budget is not None else 570.0
+    cap = os.environ.get("GALAH_BENCH_STAGE_CAP")
+    if cap:
+        budget = min(budget, float(cap))
+
+    from bench import _synth_families
+    from galah_tpu.api import generate_galah_clusterer
+    from galah_tpu.utils import timing
+
+    # The full rung costs ~2x the host e2e wall (two complete runs);
+    # under a tight budget downshift rather than print nothing.
+    if _left(budget) >= 240:
+        n_genomes, n_families = 1000, 250
+    else:
+        n_genomes, n_families = 200, 50
+    paths = _synth_families(n_genomes=n_genomes, genome_len=100_000,
+                            n_families=n_families, mut=0.03, seed=11)
+
+    out = {
+        "workload": f"{n_genomes} synthetic genomes, {n_families} "
+                    "planted families x4, 3% mutation, 100 kbp, "
+                    "default murmur3 finch+skani",
+        "n_genomes": n_genomes,
+        "skipped": [],
+    }
+    clusterings = {}
+
+    def run_one(strategy):
+        os.environ["GALAH_TPU_GREEDY_STRATEGY"] = strategy
+        try:
+            before = timing.GLOBAL.counters()
+            t0 = time.perf_counter()
+            clusterer = generate_galah_clusterer(list(paths),
+                                                 dict(_VALUES))
+            clusters = clusterer.cluster()
+            dt = time.perf_counter() - t0
+            after = timing.GLOBAL.counters()
+        finally:
+            del os.environ["GALAH_TPU_GREEDY_STRATEGY"]
+        clusterings[strategy] = clusters
+        out[f"{strategy}_genomes_per_sec"] = round(len(paths) / dt, 2)
+        out[f"{strategy}_seconds"] = round(dt, 3)
+        out[f"{strategy}_n_clusters"] = len(clusters)
+        if strategy == "device":
+            out["counters"] = {
+                k: after.get(k, 0) - before.get(k, 0)
+                for k in _COUNTERS if after.get(k, 0) - before.get(
+                    k, 0)}
+
+    # Device first: its window-fold jit compiles are billed to it.
+    for strategy in ("device", "host"):
+        if _left(budget) < 30:
+            out["skipped"].append(strategy)
+            continue
+        try:
+            run_one(strategy)
+        except Exception as e:  # noqa: BLE001 - partial JSON > crash
+            out[f"{strategy}_error"] = f"{type(e).__name__}: {e}"
+
+    if "device" in clusterings and "host" in clusterings:
+        out["parity"] = clusterings["device"] == clusterings["host"]
+        if out["parity"] and out.get("host_genomes_per_sec"):
+            out["speedup"] = round(
+                out["device_genomes_per_sec"]
+                / out["host_genomes_per_sec"], 2)
+        elif not out["parity"]:
+            out["speedup"] = 0.0
+
+    print("ENGINE_ROUNDS_JSON " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

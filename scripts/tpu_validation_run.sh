@@ -83,6 +83,12 @@ run_stage amortized 1800 python -u scripts/bench_amortized.py
 run_stage fragment_variants 600 python -u scripts/bench_fragment_variants.py
 run_stage bench "$BENCH_TIMEOUT" env \
   GALAH_BENCH_STAGE_CAP=$((BENCH_TIMEOUT - 120)) python -u bench.py
+# Device-vs-host greedy selection on the synthetic 1000-genome
+# planted-family workload: parity gate + genomes/s for both strategies
+# (also runs inside bench.py; the dedicated stage survives a bench.py
+# wedge and lands in its own artifact).
+run_stage engine_rounds 900 python -u scripts/bench_engine_rounds.py \
+  --budget 840
 run_stage kernel_variants 1200 python -u scripts/bench_kernel_variants.py
 run_stage sketch_variants 1200 python -u scripts/bench_sketch_variants.py
 run_stage ladder_tpu 3600 python -u scripts/ladder_bench.py --n 1000 \

@@ -108,12 +108,13 @@ def test_all18_fast_mode_matches_dense_goldens(mag_paths):
             == GOLDEN_95
 
 
-def test_windowed_waste_bounded_on_abisko18(mag_paths):
+def test_windowed_waste_bounded_on_abisko18(mag_paths, monkeypatch):
     """Force the windowed rep scan (dense warm pass off) over all 18
     MAGs and bound the measured speculative waste: the membership
     argmax consults every (non-rep, rep) pair anyway, so the window's
     extra ANIs are almost all consumed — the counter proves the
     docstring's claim instead of asserting it."""
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "host")
     from galah_tpu.api import generate_galah_clusterer
     from galah_tpu.cluster import cluster as engine_cluster
     from galah_tpu.utils import timing

@@ -110,9 +110,11 @@ def test_distance_cache_none_values_roundtrip(tmp_path):
     assert back.contains((1, 2)) and back.get((1, 2)) is None
 
 
-def test_dense_precluster_single_dispatch_same_result():
+def test_dense_precluster_single_dispatch_same_result(monkeypatch):
     """Small preclusters warm ALL hit pairs in one backend call; the
-    clusters must equal the per-genome dispatch path's exactly."""
+    clusters must equal the per-genome dispatch path's exactly. (The
+    dense-warm pass is a host-strategy mechanism — pin it.)"""
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "host")
     from galah_tpu.cluster.engine import cluster as eng_cluster
 
     pre = FakePre()

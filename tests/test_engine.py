@@ -183,11 +183,12 @@ def test_pair_key_sorted():
     assert pair_key(2, 5) == (2, 5)
 
 
-def test_windowed_rep_scan_bounds_dispatches():
+def test_windowed_rep_scan_bounds_dispatches(monkeypatch):
     """A large precluster (above the dense-warm cap) must issue far
     fewer backend batches than one per genome: the windowed rep scan
     (engine.REP_SCAN_WINDOW) batches a window of upcoming genomes
     against all current reps speculatively."""
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "host")
     n = 200
     # one family: genome 0 absorbs everyone (ANI 0.99 to all); all
     # pairs are precluster hits so the candidate sets are maximal
@@ -208,10 +209,11 @@ def test_windowed_rep_scan_bounds_dispatches():
     assert len(cl.calls) <= 8, len(cl.calls)
 
 
-def test_rep_scan_window_invariance_and_waste_counters():
+def test_rep_scan_window_invariance_and_waste_counters(monkeypatch):
     """Clusters are identical for any rep_scan_window (the speculative
     batches only pre-fill the ANI cache; decisions read the same
     values), and the waste counters account computed vs consulted."""
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "host")
     from galah_tpu.utils import timing
 
     n = 60
@@ -243,9 +245,11 @@ def test_rep_scan_window_invariance_and_waste_counters():
     assert [len(c) for c in results[0]] == [20, 20, 20]
 
 
-def test_warm_pass_waste_is_counted():
+def test_warm_pass_waste_is_counted(monkeypatch):
     """The dense-warm path's upfront ANIs enter the computed counter,
-    so unconsulted warm pairs surface as waste."""
+    so unconsulted warm pairs surface as waste (the warm pass belongs
+    to the host strategy; the device rounds never over-materialize)."""
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "host")
     from galah_tpu.utils import timing
 
     n = 8

@@ -1,0 +1,306 @@
+"""Round-based device greedy selection (ops/greedy_select.py).
+
+The device strategy must be DECISION-IDENTICAL to the host scan: same
+representatives, same memberships, ties to the lowest index, on every
+workload — speculative rounds and the jitted window fold change only
+when ANIs are computed, never what is decided. These tests pin that
+parity on the planted-family rung shape, the dense single-family worst
+case, and a seeded conflict window that forces the host-order
+fallback, plus the round-granular checkpoint replay.
+"""
+
+import json
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pytest
+
+from galah_tpu.backends.base import ClusterBackend, PreclusterBackend
+from galah_tpu.cluster import cluster
+from galah_tpu.cluster.cache import PairDistanceCache
+from galah_tpu.cluster.checkpoint import ClusterCheckpoint, run_fingerprint
+from galah_tpu.utils import timing
+
+
+class TablePre(PreclusterBackend):
+    def __init__(self, pairs):
+        self.pairs = pairs
+
+    def method_name(self):
+        return "stub-pre"
+
+    def distances(self, genome_paths):
+        cache = PairDistanceCache()
+        for (i, j), ani in self.pairs.items():
+            cache.insert((i, j), ani)
+        return cache
+
+
+class TableCl(ClusterBackend):
+    """Exact ANI from a lookup table; absent pairs are gated (None)."""
+
+    def __init__(self, table, threshold, fail_on_call=None):
+        self.table = {frozenset(k): v for k, v in table.items()}
+        self.threshold = threshold
+        self.calls: List[list] = []
+        self.pairs_computed: List[tuple] = []
+        self.fail_on_call = fail_on_call
+
+    def method_name(self):
+        return "stub-exact"
+
+    @property
+    def ani_threshold(self):
+        return self.threshold
+
+    def calculate_ani_batch(
+            self, pairs: Sequence[tuple]) -> List[Optional[float]]:
+        self.calls.append(list(pairs))
+        if (self.fail_on_call is not None
+                and len(self.calls) >= self.fail_on_call):
+            raise RuntimeError("injected backend failure")
+        self.pairs_computed.extend(pairs)
+        return [self.table.get(frozenset(p)) for p in pairs]
+
+
+def g(n):
+    return [f"g{i}.fna" for i in range(n)]
+
+
+def _family_workload(n_families, fam_size, seed, none_rate=0.05,
+                     thr=0.95):
+    """Planted families with randomized exact ANIs straddling the
+    threshold (and a few gated-None pairs), the stub twin of the bench
+    ladder's e2e rung shape."""
+    rng = np.random.default_rng(seed)
+    pre, table = {}, {}
+    for f in range(n_families):
+        base = f * fam_size
+        for a in range(fam_size):
+            for b in range(a + 1, fam_size):
+                i, j = base + a, base + b
+                pre[(i, j)] = 0.96
+                if rng.random() < none_rate:
+                    table[(f"g{i}.fna", f"g{j}.fna")] = None
+                else:
+                    table[(f"g{i}.fna", f"g{j}.fna")] = round(
+                        float(rng.uniform(thr - 0.05, thr + 0.04)), 6)
+    return pre, table
+
+
+def _run(monkeypatch, strategy, n, pre, table, thr=0.95, **kw):
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", strategy)
+    return cluster(g(n), TablePre(pre), TableCl(table, thr), **kw)
+
+
+def test_planted_families_1000_parity(monkeypatch):
+    """Golden-cluster equality on the 1000-genome rung shape: 250
+    families x 4, randomized near-threshold ANIs with gated pairs."""
+    pre, table = _family_workload(250, 4, seed=11)
+    host = _run(monkeypatch, "host", 1000, pre, table)
+    dev = _run(monkeypatch, "device", 1000, pre, table)
+    assert dev == host
+
+
+def test_dense_single_family_parity(monkeypatch):
+    """The mega-family worst case: ONE precluster bigger than
+    DENSE_PRECLUSTER_CAP with every pair a hit, ANIs straddling the
+    threshold so rep chains and argmax ties both occur."""
+    rng = np.random.default_rng(3)
+    n = 96
+    pre, table = {}, {}
+    for i in range(n):
+        for j in range(i + 1, n):
+            pre[(i, j)] = 0.96
+            table[(f"g{i}.fna", f"g{j}.fna")] = round(
+                float(rng.uniform(0.90, 0.99)), 6)
+    host = _run(monkeypatch, "host", n, pre, table)
+    dev = _run(monkeypatch, "device", n, pre, table)
+    assert dev == host
+
+
+def test_randomized_sparse_parity_sweep(monkeypatch):
+    """Fuzz across precluster topologies: random hit graphs (not just
+    cliques), random sizes, 10% gated pairs."""
+    for seed in range(8):
+        rng = np.random.default_rng(100 + seed)
+        n = int(rng.integers(5, 40))
+        pre, table = {}, {}
+        for i in range(n):
+            for j in range(i + 1, n):
+                if rng.random() < 0.35:
+                    pre[(i, j)] = 0.96
+                    table[(f"g{i}.fna", f"g{j}.fna")] = (
+                        None if rng.random() < 0.10
+                        else round(float(rng.uniform(0.88, 0.99)), 6))
+        host = _run(monkeypatch, "host", n, pre, table)
+        dev = _run(monkeypatch, "device", n, pre, table)
+        assert dev == host, f"seed {seed}"
+
+
+def test_rep_rounds_width_invariance(monkeypatch):
+    """The round width K changes batching only — every width yields
+    the host clustering."""
+    pre, table = _family_workload(6, 4, seed=7)
+    host = _run(monkeypatch, "host", 24, pre, table)
+    for width in (1, 2, 3, 7, 64):
+        dev = _run(monkeypatch, "device", 24, pre, table,
+                   rep_rounds=width)
+        assert dev == host, f"rep_rounds={width}"
+
+
+def test_seeded_conflict_window_falls_back(monkeypatch):
+    """A precluster whose rep chain is deeper than MAX_SUBROUNDS (every
+    pair sub-threshold -> every genome its own rep) must be counted as
+    a conflict window, finish on the host-order scan, and still match
+    the host clustering."""
+    n = 40  # one precluster, chain depth 40 > MAX_SUBROUNDS (16)
+    pre, table = {}, {}
+    for i in range(n):
+        for j in range(i + 1, n):
+            pre[(i, j)] = 0.96
+            table[(f"g{i}.fna", f"g{j}.fna")] = 0.90  # all below thr
+    host = _run(monkeypatch, "host", n, pre, table)
+    before = timing.GLOBAL.counters()
+    dev = _run(monkeypatch, "device", n, pre, table)
+    after = timing.GLOBAL.counters()
+    assert dev == host
+    assert after.get("greedy-conflict-windows", 0) > before.get(
+        "greedy-conflict-windows", 0)
+    assert after.get("greedy-host-fallback-windows", 0) > before.get(
+        "greedy-host-fallback-windows", 0)
+
+
+def test_device_strategy_counter_and_rounds(monkeypatch):
+    pre, table = _family_workload(4, 4, seed=5)
+    before = timing.GLOBAL.counters()
+    _run(monkeypatch, "device", 16, pre, table)
+    after = timing.GLOBAL.counters()
+    assert after.get("greedy-strategy-device", 0) == before.get(
+        "greedy-strategy-device", 0) + 1
+    assert after.get("greedy-rounds", 0) > before.get(
+        "greedy-rounds", 0)
+
+
+def test_interrupted_device_run_replays_rounds(monkeypatch, tmp_path):
+    """Round-granular resume: a run that dies mid-selection replays the
+    already-saved round ANIs from greedy_rounds.jsonl instead of
+    recomputing them, and finishes with the uninterrupted clustering.
+    Each backend-computed pair is paid for exactly once across both
+    runs."""
+    pre, table = _family_workload(10, 4, seed=9, none_rate=0.0)
+    n = 40
+    ref = _run(monkeypatch, "device", n, pre, table, rep_rounds=6)
+    ref_cl = TableCl(table, 0.95)
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+    cluster(g(n), TablePre(pre), ref_cl)  # count of a full run's pairs
+    n_total = len(ref_cl.pairs_computed)
+
+    fp = run_fingerprint(g(n), "stub-pre", "stub-exact", 0.95, 0.9)
+    ck1 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    cl1 = TableCl(table, 0.95, fail_on_call=4)
+    with pytest.raises(RuntimeError, match="injected backend failure"):
+        # explicit device pin: the injected failure must propagate,
+        # not demote to a host run that would finish the clustering
+        cluster(g(n), TablePre(pre), cl1, checkpoint=ck1,
+                rep_rounds=6)
+    assert (tmp_path / "ck" / "greedy_rounds.jsonl").exists()
+    assert len(cl1.pairs_computed) > 0
+
+    before = timing.GLOBAL.counters()
+    ck2 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    cl2 = TableCl(table, 0.95)
+    out = cluster(g(n), TablePre(pre), cl2, checkpoint=ck2,
+                  rep_rounds=6)
+    after = timing.GLOBAL.counters()
+    assert out == ref
+    replayed = after.get("greedy-replayed-pairs", 0) - before.get(
+        "greedy-replayed-pairs", 0)
+    assert replayed > 0
+    # no pair is recomputed: run1's saved rounds + run2's delta cover
+    # the full run exactly (run1 pairs past the last completed round
+    # were lost with the crash and are legitimately recomputed)
+    assert len(set(map(frozenset, cl2.pairs_computed))
+               | set(map(frozenset, cl1.pairs_computed))) == n_total
+    assert len(cl2.pairs_computed) < n_total
+    # a finished device run clears the round log
+    assert not (tmp_path / "ck" / "greedy_rounds.jsonl").exists()
+
+
+def test_greedy_round_log_torn_tail_tolerated(tmp_path):
+    fp = run_fingerprint(["a", "b"], "p", "c", 0.95, 0.9)
+    ck = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    ck.save_greedy_round("d1", [(0, 1, 0.97), (1, 2, None)])
+    path = tmp_path / "ck" / "greedy_rounds.jsonl"
+    with open(path, "a") as fh:
+        fh.write('{"digest": "d1", "pairs": [[3, 4, 0.9')  # torn write
+    back = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    assert back.load_greedy_rounds("d1") == [(0, 1, 0.97), (1, 2, None)]
+    assert back.load_greedy_rounds("other") == []
+    # the log is digest-scoped: records for a different pending set
+    # are ignored, not replayed
+    with open(path) as fh:
+        rows = [json.loads(line) for line in fh if line.strip()
+                and line.strip().endswith("}")]
+    assert all(r["digest"] == "d1" for r in rows)
+
+
+def test_window_select_matches_host_fold():
+    """Unit pin of the jitted fold on a hand-built window: 0 is a rep,
+    1 joins it, 2 fails the gate against 1's CLUSTER but 1 is a member
+    (not a rep) so 2 becomes a rep, 3 joins 2."""
+    from galah_tpu.ops import greedy_select
+
+    nan = float("nan")
+    thr = 0.95
+    ani = np.array([
+        [nan, 0.97, nan, 0.90],
+        [nan, nan, 0.96, nan],
+        [nan, nan, nan, 0.98],
+        [nan, nan, nan, nan],
+    ], dtype=np.float64)
+    ext = np.zeros(4, dtype=bool)
+    rep, converged = greedy_select.window_select(ani, ext, thr)
+    assert converged
+    assert rep.tolist() == [True, False, True, False]
+
+
+def test_window_select_ext_members_never_rep():
+    """A window genome with an over-threshold ANI to an EXISTING rep
+    (ext flag) is a member regardless of intra-window edges."""
+    from galah_tpu.ops import greedy_select
+
+    nan = float("nan")
+    ani = np.array([[nan, 0.99], [nan, nan]], dtype=np.float64)
+    ext = np.array([True, False])
+    rep, converged = greedy_select.window_select(ani, ext, 0.95)
+    assert converged
+    # 0 joins its existing rep; 1's only edge is to non-rep 0 -> rep
+    assert rep.tolist() == [False, True]
+
+
+def test_membership_argmax_ties_and_gaps():
+    from galah_tpu.ops import greedy_select
+
+    nan = float("nan")
+    ani = np.array([
+        [0.97, 0.97, 0.90],   # tie -> lowest rep index (argmax first)
+        [nan, 0.91, 0.96],    # gated against rep 0
+        [nan, nan, nan],      # no candidate at all
+    ], dtype=np.float64)
+    best, has = greedy_select.membership_argmax(ani)
+    assert best.tolist()[:2] == [0, 2]
+    assert has.tolist() == [True, True, False]
+
+
+def test_resolve_strategy_env(monkeypatch):
+    from galah_tpu.ops.greedy_select import resolve_greedy_strategy
+
+    monkeypatch.delenv("GALAH_TPU_GREEDY_STRATEGY", raising=False)
+    assert resolve_greedy_strategy() == ("device", False)
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "host")
+    assert resolve_greedy_strategy() == ("host", True)
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "DEVICE")
+    assert resolve_greedy_strategy() == ("device", True)
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "bogus")
+    assert resolve_greedy_strategy() == ("device", False)
