@@ -5,6 +5,7 @@ status is 1 iff any unsuppressed finding at WARNING or above remains
 (INFO notes never fail the run).
 
 Checker families
+  GL0xx  suppression hygiene (expired ``expires=`` dates)
   GL1xx  Pallas kernel contracts (tiling quanta, VMEM budget, 64-bit)
   GL2xx  host-sync / tracer leaks inside jitted bodies
   GL3xx  recompile churn (env reads in jit, unhashable static args)
@@ -12,26 +13,30 @@ Checker families
   GL5xx  abstract-eval shape contracts vs committed snapshot
   GL6xx  hardware-test marker audit
   GL7xx  observability discipline (ad-hoc timing outside obs/)
+  GL8xx  concurrency discipline (GUARDED_BY/LOCK_ORDER annotations)
+  GL9xx  numeric determinism (DETERMINISM_CONTRACT annotations)
 
 Suppression: ``# galah-lint: ignore[GL103]`` on the flagged line or
-the line above, or an entry in the committed baseline
-(``galah_tpu/analysis/baseline.json``, regenerated with
-``--update-baseline``).
+the line above (optionally ``... expires=YYYY-MM-DD``; past the date
+the comment stops suppressing and GL001 flags it), or an entry in the
+committed baseline (``galah_tpu/analysis/baseline.json``, regenerated
+with ``--update-baseline``).
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 from galah_tpu.analysis import core
 from galah_tpu.analysis.core import Finding, Severity, SourceFile
 
 CHECK_NAMES = ("pallas", "runtime", "flags", "markers", "shapes",
-               "obs")
+               "obs", "concurrency", "determinism", "suppressions")
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
                                 "baseline.json")
 
@@ -80,6 +85,18 @@ def run_checks(sources: Dict[str, SourceFile],
         from galah_tpu.analysis.obs_check import check_obs_file
         for src in sources.values():
             findings.extend(check_obs_file(src))
+    if "concurrency" in checks:
+        from galah_tpu.analysis.concurrency_check import \
+            check_concurrency
+        findings.extend(check_concurrency(sources))
+    if "determinism" in checks:
+        from galah_tpu.analysis.determinism_check import \
+            check_determinism_file
+        for src in sources.values():
+            findings.extend(check_determinism_file(src))
+    if "suppressions" in checks:
+        for src in sources.values():
+            findings.extend(core.check_suppression_expiry(src))
     return findings
 
 
@@ -94,6 +111,26 @@ def run_lint(root: Optional[str] = None,
     baseline = core.load_baseline(baseline_path or DEFAULT_BASELINE)
     core.apply_suppressions(findings, sources, baseline)
     return findings
+
+
+def changed_files(root: str) -> Optional[Set[str]]:
+    """Repo-relative paths git considers changed (staged + unstaged vs
+    HEAD, plus untracked), or None when git can't answer — the caller
+    falls back to a full scan rather than silently linting nothing."""
+    paths: Set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if proc.returncode != 0:
+            return None
+        paths.update(line.strip().replace("\\", "/")
+                     for line in proc.stdout.splitlines()
+                     if line.strip())
+    return paths
 
 
 def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
@@ -121,6 +158,19 @@ def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--show-suppressed", action="store_true",
                         help="include suppressed findings in the "
                              "human report")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="report only findings in files git "
+                             "considers changed (staged, unstaged, or "
+                             "untracked) — the pre-commit gate mode "
+                             "(scripts/lint_gate.sh); checkers still "
+                             "see the whole tree so cross-module "
+                             "rules stay sound")
+    parser.add_argument("--run-report", default=None,
+                        help="write run_report.json with the lint "
+                             "summary attached (per-family counts, "
+                             "suppressed count) so `galah-tpu report "
+                             "--diff` shows lint drift between runs. "
+                             "Env equivalent: GALAH_OBS_REPORT")
 
 
 def main(argv: Optional[Sequence[str]] = None,
@@ -134,6 +184,8 @@ def main(argv: Optional[Sequence[str]] = None,
         args = parser.parse_args(argv)
 
     t0 = time.monotonic()
+    # wall-clock stamp for the run-report header, not a measurement
+    started_at = time.time()  # galah-lint: ignore[GL701]
     if args.update_snapshots:
         from galah_tpu.analysis import shapes
         contracts, errors = shapes.compute_contracts()
@@ -148,6 +200,19 @@ def main(argv: Optional[Sequence[str]] = None,
 
     root = args.root or repo_root()
     checks = tuple(args.checks) if args.checks else CHECK_NAMES
+    changed: Optional[Set[str]] = None
+    if getattr(args, "changed_only", False):
+        changed = changed_files(root)
+        if changed is None:
+            sys.stderr.write("galah-tpu lint: --changed-only needs a "
+                             "git checkout; scanning everything\n")
+        elif not args.checks and not any(
+                p.startswith("galah_tpu/ops/")
+                or p == "galah_tpu/analysis/shapes.py"
+                for p in changed):
+            # the shapes family traces every op through jax — skip it
+            # when no kernel/op code changed (seconds per commit)
+            checks = tuple(c for c in checks if c != "shapes")
     sources = load_sources(root)
     findings = run_checks(sources, checks)
     baseline_path = args.baseline or DEFAULT_BASELINE
@@ -163,7 +228,17 @@ def main(argv: Optional[Sequence[str]] = None,
 
     baseline = core.load_baseline(baseline_path)
     core.apply_suppressions(findings, sources, baseline)
+    if changed is not None:
+        findings = [f for f in findings if f.path in changed]
     bad = core.failing(findings)
+
+    report_path = (getattr(args, "run_report", None)
+                   or os.environ.get("GALAH_OBS_REPORT"))
+    if report_path:
+        from galah_tpu import obs
+        obs.finalize("lint", report_path=report_path,
+                     started_at=started_at,
+                     lint=core.lint_summary(findings))
 
     if args.json:
         print(core.render_json(findings))

@@ -16,12 +16,13 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import datetime
 import enum
 import hashlib
 import json
 import os
 import re
-from typing import Dict, Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 
 class Severity(enum.IntEnum):
@@ -79,8 +80,12 @@ class SourceFile:
     path: str          # as given (repo-relative when scanning the repo)
     text: str
     tree: ast.Module
-    _ignores: Dict[int, frozenset] = dataclasses.field(
-        default_factory=dict)
+    # line -> (codes, expiry date or None, raw expires= text). An
+    # expired (or unparseable-date) entry no longer suppresses;
+    # check_suppression_expiry turns it into a GL001 finding.
+    _ignores: Dict[int, Tuple[frozenset, Optional[datetime.date],
+                              str]] = \
+        dataclasses.field(default_factory=dict)
 
     @classmethod
     def load(cls, path: str, rel_to: Optional[str] = None) -> "SourceFile":
@@ -93,24 +98,76 @@ class SourceFile:
         return src
 
     _IGNORE_RE = re.compile(
-        r"#\s*galah-lint:\s*ignore\[([A-Z0-9,\s*]+)\]")
+        r"#\s*galah-lint:\s*ignore\[([A-Z0-9,\s*]+)\]"
+        r"(?:\s+expires=(\S+))?")
 
     def _index_suppressions(self) -> None:
         for lineno, line in enumerate(self.text.splitlines(), start=1):
             m = self._IGNORE_RE.search(line)
-            if m:
-                codes = frozenset(
-                    c.strip() for c in m.group(1).split(",") if c.strip())
-                self._ignores[lineno] = codes
+            if not m:
+                continue
+            codes = frozenset(
+                c.strip() for c in m.group(1).split(",") if c.strip())
+            raw = m.group(2) or ""
+            expiry: Optional[datetime.date] = None
+            if raw:
+                try:
+                    expiry = datetime.date.fromisoformat(raw)
+                except ValueError:
+                    # unparseable dates never suppress;
+                    # check_suppression_expiry reports them as GL001
+                    expiry = datetime.date.min
+            self._ignores[lineno] = (codes, expiry, raw)
 
-    def is_ignored(self, code: str, line: int) -> bool:
+    def is_ignored(self, code: str, line: int,
+                   today: Optional[datetime.date] = None) -> bool:
         """Inline suppression: a matching ignore comment on the flagged
-        line or the line directly above it (``*`` matches any code)."""
+        line or the line directly above it (``*`` matches any code).
+        A comment whose ``expires=YYYY-MM-DD`` date has passed no
+        longer suppresses anything."""
+        today = today or datetime.date.today()
         for ln in (line, line - 1):
-            codes = self._ignores.get(ln)
-            if codes and (code in codes or "*" in codes):
+            entry = self._ignores.get(ln)
+            if entry is None:
+                continue
+            codes, expiry, _ = entry
+            if expiry is not None and expiry < today:
+                continue
+            if code in codes or "*" in codes:
                 return True
         return False
+
+
+def check_suppression_expiry(src: SourceFile,
+                             today: Optional[datetime.date] = None) -> \
+        List[Finding]:
+    """GL001: suppression comments whose ``expires=`` date has passed.
+
+    An expired comment has already stopped suppressing (is_ignored
+    skips it), so the original finding resurfaces on its own; this
+    finding additionally points at the stale comment itself so it gets
+    cleaned up or re-justified rather than silently ignored forever.
+    """
+    today = today or datetime.date.today()
+    out: List[Finding] = []
+    for lineno in sorted(src._ignores):
+        codes, expiry, raw = src._ignores[lineno]
+        if expiry is None:
+            continue
+        if expiry == datetime.date.min and raw != expiry.isoformat():
+            msg = (f"suppression for {', '.join(sorted(codes))} has "
+                   f"unparseable expires={raw!r} (want YYYY-MM-DD); "
+                   "it no longer suppresses anything")
+        elif expiry < today:
+            msg = (f"suppression for {', '.join(sorted(codes))} "
+                   f"expired on {expiry.isoformat()}; remove the "
+                   "comment or re-justify with a new date")
+        else:
+            continue
+        out.append(Finding(
+            code="GL001", severity=Severity.WARNING, path=src.path,
+            line=lineno, message=msg))
+    return out
 
 
 def iter_python_files(root: str,
@@ -297,20 +354,37 @@ def render_human(findings: Sequence[Finding],
     return "\n".join(lines)
 
 
-def render_json(findings: Sequence[Finding]) -> str:
+def family_of(code: str) -> str:
+    """'GL103' -> 'GL1xx' (rule families group by leading digit)."""
+    if len(code) >= 3 and code[:2] == "GL":
+        return f"GL{code[2]}xx"
+    return code
+
+
+def lint_summary(findings: Sequence[Finding]) -> dict:
+    """Counts block shared by --json output and run_report.json."""
     active = [f for f in findings if not f.suppressed]
+    by_family: Dict[str, int] = {}
+    for f in active:
+        fam = family_of(f.code)
+        by_family[fam] = by_family.get(fam, 0) + 1
+    return {
+        "errors": sum(1 for f in active
+                      if f.severity == Severity.ERROR),
+        "warnings": sum(1 for f in active
+                        if f.severity == Severity.WARNING),
+        "notes": sum(1 for f in active
+                     if f.severity == Severity.INFO),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "by_family": dict(sorted(by_family.items())),
+    }
+
+
+def render_json(findings: Sequence[Finding]) -> str:
     return json.dumps({
         "version": 1,
         "findings": [f.to_dict() for f in findings],
-        "summary": {
-            "errors": sum(1 for f in active
-                          if f.severity == Severity.ERROR),
-            "warnings": sum(1 for f in active
-                            if f.severity == Severity.WARNING),
-            "notes": sum(1 for f in active
-                         if f.severity == Severity.INFO),
-            "suppressed": sum(1 for f in findings if f.suppressed),
-        },
+        "summary": lint_summary(findings),
     }, indent=1, sort_keys=True)
 
 

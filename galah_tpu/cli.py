@@ -170,7 +170,8 @@ def build_parser() -> argparse.ArgumentParser:
     li = sub.add_parser(
         "lint",
         help="Static analysis of the codebase: Pallas kernel "
-             "contracts, tracer leaks, flag registry, shape contracts",
+             "contracts, tracer leaks, flag registry, shape "
+             "contracts, lock discipline, numeric determinism",
         description="Run the galah-tpu static-analysis suite "
                     "(equivalent to `python -m galah_tpu.analysis`); "
                     "exits 1 on any unsuppressed finding at WARNING "

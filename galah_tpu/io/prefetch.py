@@ -30,6 +30,15 @@ _POOL: ThreadPoolExecutor | None = None
 _POOL_SIZE = 0
 _POOL_LOCK = threading.Lock()
 
+# Concurrency contract, machine-checked by `galah-tpu lint` (GL8xx):
+# pool replacement must be atomic with the size check or two callers
+# could each install a pool and strand the other's generators.
+GUARDED_BY = {
+    "_POOL": "_POOL_LOCK",
+    "_POOL_SIZE": "_POOL_LOCK",
+}
+LOCK_ORDER = ["_POOL_LOCK"]
+
 
 def _shared_pool(workers: int) -> ThreadPoolExecutor:
     global _POOL, _POOL_SIZE

@@ -43,16 +43,18 @@ def reset_run() -> None:
 def finalize(subcommand: str,
              report_path: Optional[str] = None,
              argv: Optional[List[str]] = None,
-             started_at: Optional[float] = None) -> Optional[dict]:
+             started_at: Optional[float] = None,
+             lint: Optional[dict] = None) -> Optional[dict]:
     """Assemble the run report, validate it against the committed
     schema, write it when a path is given, and close the trace.
+    `lint` attaches the static-analysis summary (lint runs only).
     Telemetry failures log and return None — they never fail the run."""
     from galah_tpu.obs import report as report_mod
 
     out = None
     try:
         out = report_mod.assemble(subcommand, argv=argv,
-                                  started_at=started_at)
+                                  started_at=started_at, lint=lint)
         problems = report_mod.validate(out)
         if problems:  # a bug in assembly, not in the user's run
             logger.warning("run report failed schema validation: %s",

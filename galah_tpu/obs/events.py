@@ -26,6 +26,16 @@ _EVENTS: List[dict] = []
 _WARN_ONCE_LOCK = threading.Lock()
 _WARNED: Set[Tuple[str, str]] = set()
 
+# Concurrency contract, machine-checked by `galah-tpu lint` (GL8xx).
+# The locks are never nested today (record() is called AFTER the
+# warn-once lock is released); the declared order says which way the
+# nesting must go if that ever changes.
+GUARDED_BY = {
+    "_EVENTS": "_LOCK",
+    "_WARNED": "_WARN_ONCE_LOCK",
+}
+LOCK_ORDER = ["_WARN_ONCE_LOCK", "_LOCK"]
+
 
 def record(kind: str, **fields) -> None:
     """Append one event row; values must be JSON-serializable."""

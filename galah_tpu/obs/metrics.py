@@ -27,6 +27,21 @@ from typing import Dict, Iterator, List, Optional, Union
 
 Number = Union[int, float]
 
+# Concurrency contract, machine-checked by `galah-tpu lint` (GL8xx).
+# Registry-created metrics share the registry's lock (one lock for
+# all of it, module docstring); the per-class names below are how the
+# checker sees that same object from inside each class.
+GUARDED_BY = {
+    "Counter.value": "Counter._lock",
+    "Gauge.value": "Gauge._lock",
+    "Histogram.count": "Histogram._lock",
+    "Histogram.sum": "Histogram._lock",
+    "Histogram.min": "Histogram._lock",
+    "Histogram.max": "Histogram._lock",
+    "MetricsRegistry._metrics": "MetricsRegistry._lock",
+}
+LOCK_ORDER = ["MetricsRegistry._lock"]
+
 
 class Metric:
     """Base: a named, typed, documented series."""

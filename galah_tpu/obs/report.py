@@ -26,7 +26,7 @@ logger = logging.getLogger(__name__)
 
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
                            "run_report.schema.json")
-REPORT_VERSION = 1
+REPORT_VERSION = 2
 
 # disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
 _DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
@@ -92,10 +92,12 @@ def _split_dispatch_counters(
 
 def assemble(subcommand: str,
              argv: Optional[List[str]] = None,
-             started_at: Optional[float] = None) -> dict:
+             started_at: Optional[float] = None,
+             lint: Optional[dict] = None) -> dict:
     """The full report dict from the process-wide telemetry state
     (timing.GLOBAL, obs.metrics, obs.events, the dispatch supervisor,
-    the quarantine counter)."""
+    the quarantine counter). `lint` is the static-analysis summary
+    (core.lint_summary) attached by the lint subcommand only."""
     import galah_tpu
     from galah_tpu.obs import events as obs_events
     from galah_tpu.obs import metrics as obs_metrics
@@ -166,6 +168,8 @@ def assemble(subcommand: str,
         "metrics": metrics,
         "events": obs_events.snapshot(),
     }
+    if lint is not None:
+        report["lint"] = lint
     return report
 
 
@@ -285,6 +289,20 @@ def render(report: dict) -> str:
             lines.append(f"    {ev.get('kind')}: {extra}")
         if len(events) > 20:
             lines.append(f"    ... {len(events) - 20} more")
+    lint = report.get("lint")
+    if lint is not None:
+        fams = ", ".join(f"{fam}={n}" for fam, n in
+                         sorted(lint.get("by_family", {}).items()))
+        lines += [
+            "",
+            "lint:",
+            f"  {lint.get('errors', 0)} error(s), "
+            f"{lint.get('warnings', 0)} warning(s), "
+            f"{lint.get('notes', 0)} note(s), "
+            f"{lint.get('suppressed', 0)} suppressed",
+        ]
+        if fams:
+            lines.append(f"  by family: {fams}")
     metrics = report.get("metrics", {})
     if metrics:
         lines.append("")
@@ -371,4 +389,19 @@ def diff(a: dict, b: dict, label_a: str = "A",
     rb = {d["site"] for d in b.get("resilience", {}).get("demotions", [])}
     if ra != rb:
         lines += ["", f"demotions: {sorted(ra)} -> {sorted(rb)}"]
+
+    la, lb = a.get("lint"), b.get("lint")
+    if la is not None or lb is not None:
+        la, lb = la or {}, lb or {}
+        lines += ["", "lint drift:"]
+        for key in ("errors", "warnings", "notes", "suppressed"):
+            va, vb = int(la.get(key, 0)), int(lb.get(key, 0))
+            lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
+        famc_a = la.get("by_family", {})
+        famc_b = lb.get("by_family", {})
+        for fam in sorted(set(famc_a) | set(famc_b)):
+            va, vb = int(famc_a.get(fam, 0)), int(famc_b.get(fam, 0))
+            if va != vb:
+                lines.append(
+                    f"  {fam}: {va} -> {vb} ({vb - va:+d})")
     return "\n".join(lines) + "\n"

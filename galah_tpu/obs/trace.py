@@ -34,6 +34,17 @@ from typing import Optional
 
 logger = logging.getLogger(__name__)
 
+# Concurrency contract, machine-checked by `galah-tpu lint` (GL8xx).
+# The module globals RECORDER/_JAX_HOOKS are deliberately NOT guarded:
+# start()/stop() run in the single-threaded CLI lifecycle, and the
+# emit_* helpers take a local snapshot (`rec = RECORDER`) so a
+# concurrent stop() cannot null the reference mid-emit.
+GUARDED_BY = {
+    "TraceRecorder._fh": "TraceRecorder._lock",
+    "TraceRecorder._closed": "TraceRecorder._lock",
+}
+LOCK_ORDER = ["TraceRecorder._lock"]
+
 
 class TraceRecorder:
     """Streaming Chrome-trace writer; all emission is lock-serialized."""

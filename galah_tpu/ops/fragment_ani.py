@@ -51,6 +51,23 @@ from galah_tpu.utils import timing
 MARKER_C = 1000  # FracMinHash compression for screening markers
                  # (reference: src/skani.rs:158 "let m = 1000")
 
+# Numeric-determinism contract checked by `galah-tpu lint` (GL9xx):
+# directed/bidirectional ANI must be bit-identical across the single,
+# batch, and distributed paths. Weighted fragment coverage accumulates
+# in float64 THROUGH COMPRESSED SEGMENTS (_seq_sum over c_w[mask],
+# _segment_compressed_sums over concatenated survivors) — summing a
+# zero-filled np.where instead drifts a ulp (the PR 5 regression).
+DETERMINISM_CONTRACT = {
+    "family": "fragment",
+    "dtype": "float64",
+    "functions": ["directed_ani", "directed_ani_batch",
+                  "bidirectional_ani", "bidirectional_ani_batch",
+                  "bidirectional_ani_values",
+                  "_directed_from_counts",
+                  "_directed_from_counts_arrays",
+                  "_seq_sum", "_segment_compressed_sums"],
+}
+
 
 @dataclasses.dataclass
 class GenomeProfile:

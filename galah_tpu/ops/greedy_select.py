@@ -73,6 +73,17 @@ FOLD_ITERS = 32
 
 _MIN_BUCKET = 8
 
+# Numeric-determinism contract checked by `galah-tpu lint` (GL9xx):
+# the device window fold and the host-order scan must pick the SAME
+# representatives — selection compares scores, never re-accumulates,
+# so any float handling here must preserve the stored values exactly.
+DETERMINISM_CONTRACT = {
+    "family": "greedy_select",
+    "dtype": "float64",
+    "functions": ["window_select", "membership_argmax",
+                  "_window_select_jit", "_membership_argmax_jit"],
+}
+
 
 def resolve_greedy_strategy() -> Tuple[str, bool]:
     """(strategy, explicit) for the greedy representative scan.

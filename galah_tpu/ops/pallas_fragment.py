@@ -107,6 +107,15 @@ PALLAS_CONTRACT = {
     },
 }
 
+# Numeric-determinism contract checked by `galah-tpu lint` (GL9xx):
+# per-element membership hits are exact integer counts; the kernel and
+# the XLA fallback must agree bit-for-bit for the same window packing.
+DETERMINISM_CONTRACT = {
+    "family": "fragment",
+    "dtype": "int32",
+    "functions": ["window_element_hits", "_window_hits_jit"],
+}
+
 
 def fragment_pairs_per_launch() -> Optional[int]:
     """Optional cap on pairs packed into one launch

@@ -94,6 +94,16 @@ PALLAS_CONTRACT = {
     },
 }
 
+# Numeric-determinism contract checked by `galah-tpu lint` (GL9xx):
+# pair statistics are exact integer match counts — every pairlist
+# strategy (blocked / gather / xla / cpu) must produce bit-identical
+# (matches, lengths) for the same pairs, independent of strategy.
+DETERMINISM_CONTRACT = {
+    "family": "pairlist",
+    "dtype": "int32",
+    "functions": ["pair_stats_pairs_pallas", "_pair_stats_pairs_jit"],
+}
+
 
 def pairlist_block_pairs() -> int:
     """P for the blocked pairlist kernel (GALAH_TPU_PAIRLIST_BLOCK to

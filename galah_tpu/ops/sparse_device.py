@@ -85,6 +85,18 @@ PAIRLIST_CPU_MAX = 256   # survivor count where one host eval wins
 GATHER_ROWS = 64         # unique a-rows per gather-dense tile
 GATHER_COLS = 128        # unique b-rows per gather-dense tile
 
+# Numeric-determinism contract checked by `galah-tpu lint` (GL9xx):
+# all survivor-evaluation strategies must agree bit-for-bit on the
+# integer (matches, lengths) stats, so the AUTO strategy pick can
+# never change clustering output.
+DETERMINISM_CONTRACT = {
+    "family": "pairlist",
+    "dtype": "int32",
+    "functions": ["pair_stats_for_pairs", "threshold_pairs_sparse",
+                  "_batch_pair_stats", "_gather_dense_pair_stats",
+                  "_cpu_pair_stats"],
+}
+
 
 def _default_pair_batch() -> int:
     env = os.environ.get("GALAH_TPU_PAIR_BATCH")

@@ -46,6 +46,14 @@ logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
+# Concurrency contract, machine-checked by `galah-tpu lint` (GL8xx).
+# _demote deliberately emits telemetry AFTER releasing the lock —
+# obs/timing take their own locks and must not nest inside this one.
+GUARDED_BY = {
+    "DispatchSupervisor._demoted": "DispatchSupervisor._lock",
+}
+LOCK_ORDER = ["DispatchSupervisor._lock"]
+
 
 @dataclasses.dataclass(frozen=True)
 class Demotion:

@@ -45,6 +45,16 @@ logger = logging.getLogger(__name__)
 
 FAULT_KINDS = ("raise", "device-lost", "hang", "garbage")
 
+# Concurrency contract, machine-checked by `galah-tpu lint` (GL8xx):
+# fault draws arrive from prefetch worker threads; the fired counts
+# and the install/env-discovery globals each stay under their lock.
+GUARDED_BY = {
+    "FaultInjector._fired": "FaultInjector._lock",
+    "_INSTALLED": "_LOCK",
+    "_ENV_CHECKED": "_LOCK",
+}
+LOCK_ORDER = ["_LOCK"]
+
 
 @dataclasses.dataclass
 class FaultSpec:

@@ -24,9 +24,18 @@ import threading
 import time
 from typing import Callable, Optional, Tuple, Type, TypeVar
 
+from galah_tpu.utils import timing
+
 logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
+
+# Concurrency contract, machine-checked by `galah-tpu lint` (GL8xx):
+# this module spawns attempt threads but owns no locked shared state —
+# run_with_deadline's result box is per-call and handed off through a
+# threading.Event.
+GUARDED_BY = {}
+LOCK_ORDER = []
 
 
 class TransientDispatchError(RuntimeError):
@@ -134,7 +143,9 @@ class RetryPolicy:
                 u = random.Random(
                     f"{self.seed}:{site}:{attempt}").random()
             else:
-                u = random.random()
+                # an unseeded policy asked for nondeterministic jitter:
+                # this randomizes retry SCHEDULING, never numerics
+                u = random.random()  # galah-lint: ignore[GL904]
             d *= 1.0 - self.jitter + 2.0 * self.jitter * u
         return d
 
@@ -154,10 +165,15 @@ def run_with_deadline(fn: Callable[[], T],
         return fn()
     box: dict = {}
     done = threading.Event()
+    # adopt the spawning thread's stage context so any telemetry the
+    # attempt emits (dispatch counts, retries) attributes to the stage
+    # that issued the dispatch, not to a bare worker thread (GL804)
+    token = timing.stage_token()
 
     def target() -> None:
         try:
-            box["value"] = fn()
+            with timing.adopt(token):
+                box["value"] = fn()
         except BaseException as e:  # noqa: BLE001 - re-raised below
             box["error"] = e
         finally:

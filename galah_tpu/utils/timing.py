@@ -38,6 +38,23 @@ from galah_tpu.obs import trace as _obs_trace
 
 logger = logging.getLogger(__name__)
 
+# Concurrency contract, machine-checked by `galah-tpu lint` (GL8xx):
+# every post-construction mutation of these must hold the timer's
+# lock — spans close and counters arrive from prefetch/sketching
+# worker threads. `_active` is thread-local by design and `_t0` is
+# construction-only, so neither is locked shared state.
+GUARDED_BY = {
+    "StageTimer._acc": "StageTimer._lock",
+    "StageTimer._counts": "StageTimer._lock",
+    "StageTimer._order": "StageTimer._lock",
+    "StageTimer._counters": "StageTimer._lock",
+    "StageTimer._counter_order": "StageTimer._lock",
+    "StageTimer._tree": "StageTimer._lock",
+    "StageTimer._tree_order": "StageTimer._lock",
+    "StageTimer._shared": "StageTimer._lock",
+}
+LOCK_ORDER = ["StageTimer._lock"]
+
 
 class StageTimer:
     """Accumulating named wall-clock spans (nesting allowed)."""

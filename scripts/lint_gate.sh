@@ -1,0 +1,14 @@
+#!/bin/bash
+# Pre-commit lint gate: lint only the files git considers changed
+# (staged, unstaged, untracked). Checkers still load the whole tree so
+# cross-module rules (lock order, flag registry) stay sound — only the
+# REPORTING is scoped, and the slow shapes family is skipped unless
+# kernel/op code changed. Exit 1 iff a changed file carries an
+# unsuppressed WARNING-or-worse finding.
+#
+# Install as a git hook:   ln -s ../../scripts/lint_gate.sh .git/hooks/pre-commit
+# Run by hand:             scripts/lint_gate.sh [--json] [extra lint args]
+set -u
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+exec python -m galah_tpu.analysis --changed-only "$@"

@@ -1,0 +1,57 @@
+"""GL8xx fixture: every concurrency-discipline violation in one file."""
+
+import threading
+
+# "Cls.attr" keys guard instance state; bare keys guard module globals.
+GUARDED_BY = {
+    "Registry._items": "Registry._lock",
+    "_CACHE": "_LOCK",
+}
+LOCK_ORDER = ["_LOCK_A", "_LOCK_B"]
+
+_LOCK = threading.Lock()
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+_CACHE = {}
+
+
+class Registry:
+    def __init__(self):
+        self._items = []          # clean: construction is exempt
+        self._lock = threading.Lock()
+
+    def good_add(self, item):
+        with self._lock:
+            self._items.append(item)
+
+    def bad_add(self, item):
+        self._items.append(item)  # GL801 (mutating call, no lock)
+
+    def bad_assign(self):
+        self._items = []          # GL801 (rebind outside lock)
+
+
+def bad_global_write(key):
+    _CACHE[key] = 1               # GL801 (guarded global, no lock)
+
+
+def bad_order():
+    with _LOCK_B:
+        with _LOCK_A:             # GL802 (LOCK_ORDER says A first)
+            pass
+
+
+def self_deadlock():
+    with _LOCK:
+        with _LOCK:               # GL803 (re-acquire held Lock)
+            pass
+
+
+def plain_worker():
+    return 1
+
+
+def bad_spawns(pool):
+    pool.submit(plain_worker)                  # GL804 (no adoption)
+    t = threading.Thread(target=plain_worker)  # GL804
+    t.start()

@@ -1,0 +1,38 @@
+"""GL9xx fixture: hash-order, narrowing, unseeded RNG, stale contract."""
+
+import random
+
+import numpy as np
+
+DETERMINISM_CONTRACT = {
+    "family": "fragment",
+    "dtype": "float64",
+    "functions": ["bad_narrowing", "gone_function"],  # GL905 (stale)
+}
+
+
+def bad_narrowing(x):
+    y = x.astype(np.float32)             # GL903 (astype narrowing)
+    z = np.zeros(4, dtype=np.float32)    # GL903 (dtype= kwarg)
+    return y, z
+
+
+def bad_set_order(paths):
+    unique = set(paths)
+    order = [p for p in unique]          # GL902 (comprehension)
+    for p in {"a", "b"}:                 # GL902 (for over set literal)
+        order.append(p)
+    arr = np.array(unique)               # GL902 (materializes a set)
+    return order, arr
+
+
+def bad_rng(n):
+    u = random.random()                  # GL904 (global random state)
+    rng = np.random.default_rng()        # GL904 (no seed)
+    return u, rng.normal(size=n)
+
+
+def good_patterns(seed, items):
+    rng = np.random.default_rng(seed)    # seeded: clean
+    ordered = sorted(set(items))         # sorted set: clean
+    return rng, ordered

@@ -1,0 +1,36 @@
+"""GL901 fixture: the PR 5 masked-sum regression class.
+
+``np.where(mask, x, 0)`` keeps the full run length, so a reduceat /
+pairwise summation over it groups DIFFERENT blocks than the compressed
+segment would — the float drifts a ulp and cross-strategy bit-identity
+breaks. Compress first: ``x[mask]``.
+"""
+
+import numpy as np
+
+DETERMINISM_CONTRACT = {
+    "family": "fragment",
+    "dtype": "float64",
+    "functions": ["bad_zero_fill_reduceat", "bad_inline_sum",
+                  "bad_method_sum", "good_compressed"],
+}
+
+
+def bad_zero_fill_reduceat(c, ok, starts):
+    c_w = np.where(ok, c, 0.0)
+    return np.add.reduceat(c_w, starts)   # GL901
+
+
+def bad_inline_sum(c, ok):
+    return np.sum(np.where(ok, c, 0.0))   # GL901 (inline operand)
+
+
+def bad_method_sum(c, ok):
+    filled = np.where(ok, c, 0)
+    return filled.sum()                   # GL901 (.sum() method)
+
+
+def good_compressed(c, ok, starts):
+    # the sanctioned shape: compress the survivors, then reduce
+    kept = c[ok]
+    return float(np.sum(kept))

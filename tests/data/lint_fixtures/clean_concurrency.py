@@ -1,0 +1,52 @@
+"""Clean negative for GL8xx: annotated module with full discipline."""
+
+import threading
+
+GUARDED_BY = {
+    "Store._data": "Store._lock",
+    "_REGISTRY": "_LOCK_A",
+}
+LOCK_ORDER = ["_LOCK_A", "_LOCK_B"]
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+_REGISTRY = {}
+
+
+class Store:
+    def __init__(self):
+        self._data = {}
+        self._lock = threading.Lock()
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = value
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._data)
+
+
+def register(name, value):
+    with _LOCK_A:
+        _REGISTRY[name] = value
+
+
+def nested_in_declared_order():
+    with _LOCK_A:
+        with _LOCK_B:  # matches LOCK_ORDER: A is outermost
+            pass
+
+
+def adopted_spawns(pool):
+    from galah_tpu.utils import timing
+
+    token = timing.stage_token()
+
+    def worker():
+        with timing.adopt(token):
+            return 1
+
+    pool.submit(worker)
+    t = threading.Thread(target=worker)
+    t.start()

@@ -211,6 +211,112 @@ def test_repo_has_no_unsuppressed_adhoc_timing():
 
 
 # ---------------------------------------------------------------------------
+# GL8xx: concurrency discipline
+# ---------------------------------------------------------------------------
+
+
+def test_bad_concurrency_fires_every_rule():
+    from galah_tpu.analysis.concurrency_check import check_concurrency
+
+    src = load_fixture("bad_concurrency.py")
+    found = check_concurrency({src.path: src})
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, []).append(f.line)
+    # mutation outside lock (method call, rebind, guarded global)
+    assert sorted(by_code["GL801"]) == [28, 31, 35]
+    assert all(f.severity is Severity.ERROR
+               for f in found if f.code == "GL801")
+    # B held while acquiring A, against LOCK_ORDER = [A, B]
+    assert by_code["GL802"] == [40]
+    # re-acquiring a held non-reentrant Lock
+    assert by_code["GL803"] == [46]
+    # unadopted pool.submit + Thread(target=...)
+    assert sorted(by_code["GL804"]) == [55, 56]
+    assert sorted(by_code) == ["GL801", "GL802", "GL803", "GL804"]
+
+
+def test_clean_concurrency_is_silent():
+    from galah_tpu.analysis.concurrency_check import check_concurrency
+
+    src = load_fixture("clean_concurrency.py")
+    assert check_concurrency({src.path: src}) == []
+
+
+def test_threaded_module_without_annotations_fires_gl805():
+    import ast
+
+    from galah_tpu.analysis.concurrency_check import check_concurrency
+
+    text = "import threading\n_L = threading.Lock()\n"
+    src = SourceFile(path="galah_tpu/obs/metrics.py", text=text,
+                     tree=ast.parse(text))
+    found = check_concurrency({src.path: src})
+    assert [f.code for f in found] == ["GL805"]
+    assert "GUARDED_BY" in found[0].message
+
+
+def test_repo_concurrency_discipline_holds():
+    found = [f for f in run_lint(checks=("concurrency",))
+             if not f.suppressed]
+    assert not found, [(f.path, f.line, f.message) for f in found]
+
+
+# ---------------------------------------------------------------------------
+# GL9xx: numeric determinism
+# ---------------------------------------------------------------------------
+
+
+def test_masked_sum_regression_fixture_fires_gl901():
+    """The PR 5 class: summing a zero-filled np.where instead of the
+    compressed segment must be an ERROR in contract functions."""
+    from galah_tpu.analysis.determinism_check import \
+        check_determinism_file
+
+    found = check_determinism_file(load_fixture("bad_masked_sum.py"))
+    gl901 = sorted(f.line for f in found if f.code == "GL901")
+    # reduceat over a zero-fill name, inline np.sum, .sum() method
+    assert gl901 == [21, 25, 30]
+    assert all(f.severity is Severity.ERROR
+               for f in found if f.code == "GL901")
+    # the compressed form (c[ok]) is the sanctioned shape
+    assert not [f for f in found if f.symbol == "good_compressed"]
+
+
+def test_bad_determinism_fires_set_narrowing_rng_and_stale():
+    from galah_tpu.analysis.determinism_check import \
+        check_determinism_file
+
+    found = check_determinism_file(load_fixture("bad_determinism.py"))
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, []).append(f.line)
+    assert sorted(by_code["GL902"]) == [22, 23, 25]
+    assert sorted(by_code["GL903"]) == [15, 16]
+    assert sorted(by_code["GL904"]) == [30, 31]
+    assert by_code["GL905"] == [1]  # stale 'gone_function' entry
+    assert sorted(by_code) == ["GL902", "GL903", "GL904", "GL905"]
+    # seeded default_rng + sorted(set(...)) stay silent
+    assert not [f for f in found if f.line >= 34]
+
+
+def test_strategy_module_without_contract_fires_gl905():
+    from galah_tpu.analysis.determinism_check import (
+        STRATEGY_MODULES, check_determinism_file)
+
+    src = load_fixture("clean_case.py", path=STRATEGY_MODULES[0])
+    found = check_determinism_file(src)
+    assert any(f.code == "GL905" and "lacks" in f.message
+               for f in found)
+
+
+def test_repo_determinism_contracts_hold():
+    found = [f for f in run_lint(checks=("determinism",))
+             if not f.suppressed]
+    assert not found, [(f.path, f.line, f.message) for f in found]
+
+
+# ---------------------------------------------------------------------------
 # Clean fixture, suppressions, baseline
 # ---------------------------------------------------------------------------
 
@@ -237,6 +343,56 @@ def test_inline_suppression_and_wildcard():
     found = [f for f in check_flag_references([src]) if f.path == "x.py"]
     core.apply_suppressions(found, {"x.py": src}, {})
     assert all(f.suppressed and f.suppression == "inline" for f in found)
+
+
+def test_suppression_expires_future_past_and_unparseable():
+    import ast
+
+    # the marker token is split across adjacent literals so the lint
+    # scan of THIS file does not index these as real suppressions
+    mark = "# galah-li" "nt: ign" "ore[GL401]"
+    text = ("import os\n"
+            f"a = os.environ.get('GALAH_BOGUS')  "
+            f"{mark} expires=2999-01-01\n"
+            "\n"
+            f"b = os.environ.get('GALAH_BOGUS2')  "
+            f"{mark} expires=2001-01-01\n"
+            "\n"
+            f"c = os.environ.get('GALAH_BOGUS3')  "
+            f"{mark} expires=not-a-date\n")
+    src = SourceFile(path="x.py", text=text, tree=ast.parse(text))
+    src._index_suppressions()
+    found = [f for f in check_flag_references([src]) if f.path == "x.py"]
+    core.apply_suppressions(found, {"x.py": src}, {})
+    by_line = {f.line: f for f in found}
+    assert by_line[2].suppressed          # future date still suppresses
+    assert not by_line[4].suppressed      # expired
+    assert not by_line[6].suppressed      # unparseable never suppresses
+    expiry = core.check_suppression_expiry(src)
+    assert sorted(f.line for f in expiry) == [4, 6]
+    assert all(f.code == "GL001"
+               and f.severity is Severity.WARNING for f in expiry)
+    messages = {f.line: f.message for f in expiry}
+    assert "expired" in messages[4]
+    assert "unparseable" in messages[6]
+
+
+def test_suppression_valid_on_its_expiry_date():
+    import ast
+    import datetime
+
+    mark = "# galah-li" "nt: ign" "ore[GL401]"
+    text = ("import os\n"
+            f"a = os.environ.get('GALAH_BOGUS')  "
+            f"{mark} expires=2030-06-01\n")
+    src = SourceFile(path="x.py", text=text, tree=ast.parse(text))
+    src._index_suppressions()
+    on_date = datetime.date(2030, 6, 1)
+    after = datetime.date(2030, 6, 2)
+    assert src.is_ignored("GL401", 2, today=on_date)
+    assert not src.is_ignored("GL401", 2, today=after)
+    assert core.check_suppression_expiry(src, today=on_date) == []
+    assert core.check_suppression_expiry(src, today=after) != []
 
 
 def test_baseline_suppresses_by_fingerprint(tmp_path):
@@ -312,7 +468,7 @@ def test_lint_cli_json_contract():
     report = json.loads(proc.stdout)
     assert report["version"] == 1
     assert set(report["summary"]) == {"errors", "warnings", "notes",
-                                      "suppressed"}
+                                      "suppressed", "by_family"}
     assert report["summary"]["errors"] == 0
 
 
@@ -325,3 +481,75 @@ def test_baseline_file_is_committed_and_empty():
 def test_fixture_dir_not_scanned():
     sources = load_sources(repo_root())
     assert not [p for p in sources if "lint_fixtures" in p]
+
+
+# ---------------------------------------------------------------------------
+# Lint summary, run-report wiring, --changed-only
+# ---------------------------------------------------------------------------
+
+
+def test_lint_summary_counts_by_family():
+    from galah_tpu.analysis.determinism_check import \
+        check_determinism_file
+
+    assert core.family_of("GL103") == "GL1xx"
+    assert core.family_of("GL901") == "GL9xx"
+    found = check_determinism_file(load_fixture("bad_masked_sum.py"))
+    summary = core.lint_summary(found)
+    assert summary["errors"] == 3
+    assert summary["by_family"] == {"GL9xx": 3}
+    found[0].suppressed = True
+    summary = core.lint_summary(found)
+    assert summary["suppressed"] == 1
+    assert summary["by_family"] == {"GL9xx": 2}
+
+
+def test_lint_run_report_carries_summary(tmp_path):
+    """`galah-tpu lint --run-report` writes a schema-valid v2 report
+    with the lint section `galah-tpu report --diff` consumes."""
+    report_path = tmp_path / "lint_report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "galah_tpu.analysis",
+         "--check", "suppressions", "--run-report", str(report_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(report_path.read_text())
+    assert report["version"] == 2
+    assert report["run"]["subcommand"] == "lint"
+    assert set(report["lint"]) == {"errors", "warnings", "notes",
+                                   "suppressed", "by_family"}
+    from galah_tpu.obs import report as report_mod
+
+    assert report_mod.validate(report) == []
+
+
+def test_report_diff_shows_lint_drift():
+    from galah_tpu.obs import report as report_mod
+
+    def rep(errors, fams):
+        return {"run": {"duration_s": 1.0},
+                "lint": {"errors": errors, "warnings": 0, "notes": 0,
+                         "suppressed": 0, "by_family": fams}}
+
+    out = report_mod.diff(rep(0, {}), rep(2, {"GL9xx": 2}))
+    assert "lint drift:" in out
+    assert "errors: 0 -> 2 (+2)" in out
+    assert "GL9xx: 0 -> 2 (+2)" in out
+
+
+def test_changed_files_tracks_git_state(tmp_path):
+    from galah_tpu.analysis import changed_files
+
+    root = str(tmp_path)
+    git = ["git", "-C", root, "-c", "user.name=t",
+           "-c", "user.email=t@t"]
+    subprocess.run(["git", "init", "-q", root], check=True)
+    # no commits yet: git can't answer, caller falls back to full scan
+    assert changed_files(root) is None
+    (tmp_path / "tracked.py").write_text("x = 1\n")
+    subprocess.run(git + ["add", "tracked.py"], check=True)
+    subprocess.run(git + ["commit", "-q", "-m", "init"], check=True)
+    assert changed_files(root) == set()
+    (tmp_path / "tracked.py").write_text("x = 2\n")
+    (tmp_path / "untracked.py").write_text("y = 1\n")
+    assert changed_files(root) == {"tracked.py", "untracked.py"}

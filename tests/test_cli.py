@@ -15,11 +15,21 @@ from galah_tpu.cli import main
 
 DATA = "/root/reference/tests/data"
 
+# The golden fixture genomes live in the reference checkout, which not
+# every container bakes in. Where the data exists these tests must
+# pass (strict=False only because an xpass is then the healthy state);
+# where it doesn't they xfail instead of reporting 12 false failures.
+needs_reference_data = pytest.mark.xfail(
+    condition=not os.path.isdir(DATA),
+    reason=f"reference fixture genomes not present ({DATA})",
+    strict=False)
+
 
 def _run(args):
     return main(args)
 
 
+@needs_reference_data
 def test_completeness_4contamination_quality_score(tmp_path):
     out = tmp_path / "clusters.tsv"
     rc = _run([
@@ -39,6 +49,7 @@ def test_completeness_4contamination_quality_score(tmp_path):
         f"{DATA}/abisko4/73.20110800_S2M.16.fna\n")
 
 
+@needs_reference_data
 def test_parks2020_reduced_quality_score(tmp_path):
     out = tmp_path / "clusters.tsv"
     rc = _run([
@@ -58,6 +69,7 @@ def test_parks2020_reduced_quality_score(tmp_path):
         f"{DATA}/abisko4/73.20120800_S1D.21.fna\n")
 
 
+@needs_reference_data
 def test_output_symlink_directory(tmp_path):
     outdir = tmp_path / "reps"
     rc = _run([
@@ -73,6 +85,7 @@ def test_output_symlink_directory(tmp_path):
     assert not (outdir / "1mbp.fna").exists()
 
 
+@needs_reference_data
 def test_output_symlink_directory_preexisting_empty(tmp_path):
     outdir = tmp_path / "reps"
     outdir.mkdir()
@@ -87,6 +100,7 @@ def test_output_symlink_directory_preexisting_empty(tmp_path):
     assert (outdir / "500kb.fna").is_symlink()
 
 
+@needs_reference_data
 def test_output_directory_names_clash_copy(tmp_path):
     outdir = tmp_path / "reps"
     rc = _run([
@@ -105,6 +119,7 @@ def test_output_directory_names_clash_copy(tmp_path):
     assert not (outdir / "1mbp.fna").exists()
 
 
+@needs_reference_data
 def test_output_representative_list(tmp_path):
     out = tmp_path / "reps.txt"
     rc = _run([
@@ -122,6 +137,7 @@ def test_output_representative_list(tmp_path):
         f"{DATA}/set1/500kb.fna\n{DATA}/set1_name_clash/500kb.fna\n")
 
 
+@needs_reference_data
 def test_min_aligned_fraction(tmp_path):
     """Reference: tests/test_cmdline.rs:216-255 — 0.2 clusters the
     half-aligned pair, 0.6 splits it."""
@@ -151,6 +167,7 @@ def test_min_aligned_fraction(tmp_path):
         f"{DATA}/set2/1mbp.fna\n{DATA}/set2/1mbp.half_aligned.fna\n")
 
 
+@needs_reference_data
 def test_github7_aligned_fraction_semantics(tmp_path):
     """Reference regression for galah issue #7
     (tests/test_cmdline.rs:316-338): the antonio MAG pair clusters at
@@ -195,6 +212,7 @@ def test_skani_skani_precluster_threshold_override(tmp_path):
     assert all(line.startswith(rep + "\t") for line in lines)
 
 
+@needs_reference_data
 def test_cluster_validate_roundtrip(tmp_path):
     clusters = tmp_path / "clusters.tsv"
     rc = _run([
@@ -315,6 +333,7 @@ def test_fraglen_flag_flips_clustering(tmp_path):
     assert reps_1000.read_text() == f"{a}\n{b}\n"  # gated: two reps
 
 
+@needs_reference_data
 def test_dist_subcommand_golden_pair(tmp_path):
     """`dist` (the reference ships this subcommand disabled, reference:
     src/main.rs:88-114): all-pairs MinHash ANI TSV, pinning the golden
@@ -333,6 +352,7 @@ def test_dist_subcommand_golden_pair(tmp_path):
     assert abs(float(ani) - 0.9808188) < 5e-7
 
 
+@needs_reference_data
 def test_dist_min_ani_filters(tmp_path):
     out = tmp_path / "dist.tsv"
     rc = _run([
@@ -384,6 +404,7 @@ def test_validate_output_paths_mirrors_setup(tmp_path):
                 shutil.rmtree(tmp_path / "a")
 
 
+@needs_reference_data
 def test_platform_flag_forces_backend(tmp_path):
     """--platform cpu must win over any interpreter-level platform
     default (a sitecustomize pinning a device backend overrides the
