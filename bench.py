@@ -153,25 +153,35 @@ def probe_backend(timeout=None, retry_timeout=None):
     not give up on a tunnel the test harness would still reach; a slow
     axon attach can take minutes after an outage). The retry gets a
     quarter of that so a dead tunnel costs at most ~1.25x the budget
-    before the honest CPU fallback. The failure reason is a ONE-LINE
-    token (`probe-timeout after Ns` / `ExcType: first 200 chars`), not
-    a traceback — it lands verbatim in the BENCH errors array."""
+    before the honest CPU fallback.
+
+    Returns ``(ok, reason, detail)``. ``reason`` is a single TOKEN
+    (`probe-timeout` or the exception type name, never whitespace or a
+    command repr) — it is what lands in the BENCH errors array, where
+    downstream grep/ledger tooling treats each error as one
+    space-delimited `key=value` line. ``detail`` carries the longer
+    one-line text (timeout budget / first 200 chars of the message)
+    for the structured `backend_reason_detail` field only."""
     from galah_tpu.config import env_value
 
     if timeout is None:
         timeout = float(env_value("GALAH_BENCH_PROBE_TIMEOUT"))
     if retry_timeout is None:
         retry_timeout = max(30.0, timeout / 4.0)
-    last = None
+    reason = detail = None
     for t in (timeout, retry_timeout):
         try:
             run_sub(_PROBE_CODE, t)
-            return True, None
+            return True, None, None
         except subprocess.TimeoutExpired:
-            last = f"probe-timeout after {t:.0f}s"
+            # str(TimeoutExpired) embeds the full subprocess command
+            # repr — never let that into reason or detail.
+            reason = "probe-timeout"
+            detail = f"probe-timeout after {t:.0f}s"
         except Exception as e:  # noqa: BLE001 - report, don't crash
-            last = f"{type(e).__name__}: {str(e)[:200]}"
-    return False, last
+            reason = type(e).__name__
+            detail = " ".join(str(e).split())[:200] or reason
+    return False, reason, detail
 
 
 def _sketches(n, sketch_size, seed):
@@ -659,6 +669,16 @@ def _finalize_obs(result, started_at):
             "bench." + result["metric"],
             help="Headline bench metric",
             unit=result.get("unit", "")).set(result["value"])
+        # Workload fingerprint gauges: the perf ledger keys cross-run
+        # comparison on (N, K), so bench history only compares like
+        # workloads (obs/ledger.py workload_fingerprint).
+        obs.metrics.gauge(
+            "workload.n_genomes",
+            help="Bench production workload size").set(
+            float(result.get("n_genomes", PRODUCTION_N)))
+        obs.metrics.gauge(
+            "workload.sketch_k",
+            help="Bench sketch size").set(float(SKETCH_SIZE))
         if result.get("vs_baseline") is not None:
             obs.metrics.gauge(
                 "bench.vs_baseline",
@@ -736,15 +756,17 @@ def main():
         errors.append(f"cpu_production: {type(e).__name__}: {e}")
 
     # 2. Bounded-timeout probe of the device backend, one retry.
-    ok, err = probe_backend()
+    ok, reason, detail = probe_backend()
     if not ok:
         # TPU unreachable: report the honest CPU measurement instead of
-        # a dead zero — the line stays parseable and the backend label +
-        # backend_reason record (in one line, not a traceback) that no
-        # TPU number was captured.
-        errors.append(f"backend=cpu-fallback reason={err}")
+        # a dead zero — the line stays parseable. The errors entry is a
+        # pure key=value token line (reason is a single token, e.g.
+        # `probe-timeout`); the longer human text goes only to the
+        # structured backend_reason_detail field.
+        errors.append(f"backend=cpu-fallback reason={reason}")
         result["backend"] = "cpu-fallback"
-        result["backend_reason"] = err
+        result["backend_reason"] = reason
+        result["backend_reason_detail"] = detail
         cpu_prod = stages.get("cpu_production_pairs_per_sec")
         if cpu_prod:
             result["value"] = cpu_prod

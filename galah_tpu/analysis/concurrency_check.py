@@ -62,6 +62,7 @@ THREADED_MODULES = (
     "galah_tpu/obs/metrics.py",
     "galah_tpu/obs/trace.py",
     "galah_tpu/obs/events.py",
+    "galah_tpu/obs/profile.py",
     "galah_tpu/io/prefetch.py",
     "galah_tpu/resilience/dispatch.py",
     "galah_tpu/resilience/policy.py",

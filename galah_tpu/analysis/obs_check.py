@@ -29,6 +29,15 @@ Checks
   GL702  logging call whose literal message embeds a formatted
          seconds figure (``%.2fs`` / f-string ``{dt:.1f}s``) — the
          signature of a measured duration that lives only in the log.
+  GL703  direct device-cost introspection (``.memory_stats()`` /
+         ``.cost_analysis()``) in a pipeline module. Device cost
+         attribution belongs to ``obs/profile.py`` (the ``@profiled``
+         registry + ``sample_memory``): an ad-hoc ``memory_stats()``
+         read is invisible to the run report's ``device_costs``
+         section and to the perf ledger, and an ad-hoc
+         ``cost_analysis()`` forces a second trace/lowering of a
+         function the profiler already compiled. obs/ is exempt by
+         scope, so profile.py itself is the one sanctioned caller.
 
 Suppression: the usual inline comment on the flagged line or the line
 above, with a justification —
@@ -55,6 +64,13 @@ TIMING_CALLS = frozenset({
     "time.perf_counter_ns",
     "time.process_time",
 })
+
+# Device-cost introspection methods GL703 reserves for obs/profile.py.
+# Matched as attribute calls (``<anything>.memory_stats()``) because
+# the receiver is a runtime Device / Compiled object the AST cannot
+# type; the method names are specific enough that a pipeline-module
+# hit is a real bypass of the profiler.
+DEVICE_COST_CALLS = frozenset({"memory_stats", "cost_analysis"})
 
 _EXEMPT_PREFIXES = ("galah_tpu/utils/", "galah_tpu/obs/",
                     "galah_tpu/analysis/")
@@ -131,7 +147,8 @@ def _literal_has_seconds(node: ast.AST) -> bool:
 
 
 def check_obs_file(src: SourceFile) -> List[Finding]:
-    """GL701/GL702 over one source file (no-op outside the scope)."""
+    """GL701/GL702/GL703 over one source file (no-op outside the
+    scope)."""
     if not in_scope(src.path):
         return []
     findings: List[Finding] = []
@@ -157,6 +174,16 @@ def check_obs_file(src: SourceFile) -> List[Finding]:
                 "durations with an obs.metrics histogram's .time() "
                 "(or a utils/timing stage) so they land in the run "
                 "report, not only in locals"))
+            continue
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in DEVICE_COST_CALLS):
+            findings.append(Finding(
+                "GL703", Severity.WARNING, src.path, node.lineno,
+                f"direct .{node.func.attr}() in a pipeline module — "
+                "device-cost introspection belongs to obs/profile.py "
+                "(@profiled entry points + profile.sample_memory), "
+                "where the numbers reach the run report's "
+                "device_costs section and the perf ledger"))
             continue
         if _is_log_call(node) and any(
                 _literal_has_seconds(a) for a in node.args):

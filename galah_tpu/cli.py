@@ -13,6 +13,7 @@ import argparse
 import logging
 import os
 import sys
+import time
 
 import galah_tpu
 from galah_tpu.api import add_cluster_arguments, generate_galah_clusterer
@@ -196,8 +197,65 @@ def build_parser() -> argparse.ArgumentParser:
                     help="Compare exactly two reports: per-stage "
                          "wall-clock, dispatch/funnel, and per-metric "
                          "deltas")
+    pf = sub.add_parser(
+        "perf",
+        help="Record, inspect, and gate on the cross-run performance "
+             "ledger",
+        description="The append-only perf ledger (JSONL, fed "
+                    "automatically by runs with GALAH_OBS_LEDGER set) "
+                    "keys every entry by backend, device topology, "
+                    "workload fingerprint (N/K/P), and strategy. "
+                    "`record` appends a run report's metrics, "
+                    "`history` prints one metric's trajectory, and "
+                    "`check` compares the newest entry against a "
+                    "median±MAD noise band over the last entries of "
+                    "the same key, exiting 1 on regression "
+                    "(docs/observability.md)")
+    _add_verbosity(pf)
+    pf.add_argument("--ledger", default=None,
+                    help="Ledger file (default: GALAH_OBS_LEDGER)")
+    pfsub = pf.add_subparsers(dest="perf_action")
+    pfr = pfsub.add_parser(
+        "record", help="Append a run report's metrics to the ledger")
+    pfr.add_argument("report", metavar="REPORT",
+                     help="run_report.json to ingest")
+    pfr.add_argument("--source", default="manual",
+                     help="Key component naming what produced the "
+                          "report (default: manual)")
+    pfh = pfsub.add_parser(
+        "history", help="Print one metric's cross-run trajectory")
+    pfh.add_argument("metric", metavar="METRIC",
+                     help="Metric name (e.g. run.duration_s, "
+                          "bench.e2e_1000_genomes_per_sec)")
+    pfh.add_argument("--key", default=None,
+                     help="Only entries whose canonical key contains "
+                          "this substring")
+    pfc = pfsub.add_parser(
+        "check",
+        help="Gate: newest entry vs the same-key noise band "
+             "(exit 1 on regression)")
+    pfc.add_argument("--report", default=None,
+                     help="Check this run_report.json against the "
+                          "ledger instead of the ledger's own newest "
+                          "entry (nothing is appended)")
+    pfc.add_argument("--source", default="manual",
+                     help="Key source component for --report entries")
+    pfc.add_argument("--window", type=int, default=None,
+                     help="Same-key history window (default: "
+                          "GALAH_OBS_LEDGER_WINDOW)")
+    pfc.add_argument("--mad-k", type=float, default=None,
+                     help="Noise-band width in MADs (default: "
+                          "GALAH_OBS_LEDGER_MAD_K)")
+    pfc.add_argument("--min-history", type=int, default=None,
+                     help="Entries required before a verdict "
+                          "(default: 3)")
+    pfc.add_argument("--soft", action="store_true",
+                     help="Report regressions but exit 0 — the CI "
+                          "mode while a key is still accumulating "
+                          "trustworthy history")
     parser._subcommand_parsers = {"cluster": c, "cluster-validate": v,
-                                  "dist": dd, "lint": li, "report": rp}
+                                  "dist": dd, "lint": li, "report": rp,
+                                  "perf": pf}
     return parser
 
 
@@ -481,6 +539,100 @@ def run_report_cmd(args) -> int:
     return 0
 
 
+def run_perf_cmd(args) -> int:
+    """`galah-tpu perf record|history|check` over the JSONL ledger.
+    Pure file I/O (like `report`): never touches jax."""
+    from galah_tpu.config import env_value
+    from galah_tpu.obs import ledger as ledger_mod
+    from galah_tpu.obs import report as report_mod
+
+    ledger_path = args.ledger or env_value("GALAH_OBS_LEDGER")
+    if not ledger_path:
+        logger.error("no ledger: pass --ledger or set "
+                     "GALAH_OBS_LEDGER")
+        return 1
+    action = getattr(args, "perf_action", None)
+    if action is None:
+        logger.error("perf needs an action: record, history, or check")
+        return 1
+
+    if action == "record":
+        try:
+            rep = report_mod.load(args.report)
+        except Exception as e:  # noqa: BLE001 — bad JSON, missing file
+            logger.error("%s: cannot read run report (%s)",
+                         args.report, e)
+            return 1
+        entry = ledger_mod.entry_from_report(rep, args.source)
+        ledger_mod.append(ledger_path, entry)
+        print(f"recorded {len(entry['metrics'])} metric(s) to "
+              f"{ledger_path}")
+        return 0
+
+    entries, skipped = ledger_mod.read(ledger_path)
+    if skipped:
+        logger.warning("%s: skipped %d torn/corrupt line(s)",
+                       ledger_path, skipped)
+
+    if action == "history":
+        rows = ledger_mod.history(entries, args.metric)
+        if args.key:
+            rows = [r for r in rows if args.key in r["key"]]
+        if not rows:
+            print(f"no entries carry metric {args.metric!r}")
+            return 0
+        for r in rows:
+            ts = time.strftime("%Y-%m-%d %H:%M",
+                               time.localtime(r["ts"] or 0))
+            print(f"{ts}  {r['sha'] or '-':>9}  {r['value']:<14.6g} "
+                  f"{r['key']}")
+        return 0
+
+    # check
+    if getattr(args, "report", None):
+        try:
+            rep = report_mod.load(args.report)
+        except Exception as e:  # noqa: BLE001
+            logger.error("%s: cannot read run report (%s)",
+                         args.report, e)
+            return 1
+        current = ledger_mod.entry_from_report(rep, args.source)
+        history = entries
+    else:
+        if not entries:
+            print("ledger is empty; nothing to check")
+            return 0
+        current, history = entries[-1], entries[:-1]
+    window = (args.window if args.window is not None
+              else int(env_value("GALAH_OBS_LEDGER_WINDOW")))
+    mad_k = (args.mad_k if args.mad_k is not None
+             else float(env_value("GALAH_OBS_LEDGER_MAD_K")))
+    min_history = (args.min_history if args.min_history is not None
+                   else ledger_mod.MIN_HISTORY)
+    verdicts = ledger_mod.check(history, current, window=window,
+                                mad_k=mad_k, min_history=min_history)
+    bad = ledger_mod.regressions(verdicts)
+    counts: dict = {}
+    for v in verdicts:
+        counts[v["verdict"]] = counts.get(v["verdict"], 0) + 1
+    for v in verdicts:
+        if v["verdict"] in ("ok", "insufficient-history"):
+            continue
+        band = v.get("band")
+        band_s = (f" band=[{band[0]:.6g}, {band[1]:.6g}] "
+                  f"(median {v['median']:.6g}, n={v['n_history']})"
+                  if band else "")
+        print(f"{v['verdict'].upper()}: {v['metric']} = "
+              f"{v['value']:.6g}{band_s}")
+    summary = ", ".join(f"{k}={counts[k]}" for k in sorted(counts)) \
+        or "no comparable metrics"
+    print(f"perf check [{ledger_mod.key_of(current)}]: {summary}")
+    if bad and args.soft:
+        print(f"--soft: {len(bad)} regression(s) reported, not gated")
+        return 0
+    return 1 if bad else 0
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -515,6 +667,10 @@ def main(argv=None) -> int:
         # Pure file I/O — never touches jax, so it skips the platform
         # probe and works on hosts with no usable accelerator at all.
         return run_report_cmd(args)
+    if args.subcommand == "perf":
+        # Same discipline: the ledger gate must run on CI hosts and
+        # laptops with no accelerator, so it never imports jax.
+        return run_perf_cmd(args)
     platform = (getattr(args, "platform", None)
                 or os.environ.get("GALAH_TPU_PLATFORM"))
     if platform:

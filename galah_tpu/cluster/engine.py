@@ -120,16 +120,34 @@ def cluster(
             "Preclustering and clustering methods are the same, "
             "so reusing ANI values")
 
+    # Workload fingerprint gauges: the perf ledger (obs/ledger.py) keys
+    # cross-run comparison on them, so a run is only compared against
+    # history with the same N and sketch K.
+    from galah_tpu.obs import metrics as obs_metrics
+    from galah_tpu.obs import profile as obs_profile
+
+    obs_metrics.gauge(
+        "workload.n_genomes",
+        help="Genomes in this clustering run").set(float(len(genomes)))
+    sketch_k = getattr(preclusterer, "sketch_size", None)
+    if sketch_k:
+        obs_metrics.gauge(
+            "workload.sketch_k",
+            help="MinHash sketch size of the precluster backend").set(
+            float(sketch_k))
+
     pre_cache = checkpoint.load_distances() if checkpoint else None
     if pre_cache is None:
         with timing.stage("precluster-distances"):
             pre_cache = preclusterer.distances(genomes)
+        obs_profile.sample_memory("precluster-distances")
         if checkpoint:
             checkpoint.save_distances(pre_cache)
 
     logger.info("Preclustering ..")
     with timing.stage("partition"):
         preclusters = partition_preclusters(len(genomes), pre_cache.keys())
+    obs_profile.sample_memory("partition")
     logger.info("Found %d preclusters. The largest contained %d genomes",
                 len(preclusters), len(preclusters[0]) if preclusters else 0)
 
@@ -214,6 +232,7 @@ def cluster(
             all_clusters.extend(global_clusters)
             if checkpoint:
                 checkpoint.save_precluster(pc_index, global_clusters)
+    obs_profile.sample_memory("greedy-cluster")
     logger.info("Found %d clusters", len(all_clusters))
     return all_clusters
 

@@ -183,6 +183,30 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "JAX compile events, resilience events; Perfetto-"
               "loadable) to this path; the --trace-events flag's env "
               "twin and loses to it"),
+    Flag("GALAH_OBS_PROFILE", kind="bool", default="1",
+         section="observability",
+         help="Device-cost attribution for registered jit/Pallas "
+              "entry points (XLA cost_analysis FLOPs/bytes, compile "
+              "walls, HBM high-water, roofline utilization) into the "
+              "run report's device_costs section; 0 disables the "
+              "profiled-dispatch path entirely"),
+    Flag("GALAH_OBS_LEDGER", section="observability",
+         help="Append one entry per finalized run to this cross-run "
+              "perf ledger (JSONL, keyed by backend/topology/"
+              "workload/strategy); inspect and gate with the "
+              "`galah-tpu perf` subcommand (docs/observability.md). "
+              "Unset disables the ledger feed"),
+    Flag("GALAH_OBS_LEDGER_WINDOW", kind="int", default="8",
+         section="observability",
+         help="How many most-recent same-key ledger entries form the "
+              "`perf check` noise band"),
+    Flag("GALAH_OBS_LEDGER_MAD_K", kind="float", default="4",
+         section="observability",
+         help="Width of the `perf check` noise band, in MADs around "
+              "the window median (the MAD is floored at 1 percent of "
+              "the "
+              "median so an all-identical history cannot gate on "
+              "epsilon)"),
     # -- resilience --------------------------------------------------------
     Flag("GALAH_FI", kind="grammar", section="resilience",
          help="Deterministic fault injection, e.g. "

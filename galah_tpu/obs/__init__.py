@@ -28,16 +28,18 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
-from galah_tpu.obs import events, metrics, trace  # noqa: F401
+from galah_tpu.obs import events, metrics, profile, trace  # noqa: F401
 
 logger = logging.getLogger(__name__)
 
 
 def reset_run() -> None:
-    """Fresh metrics + events for a new run (trace recorder unchanged:
-    its lifetime is the CLI invocation, managed by start/stop)."""
+    """Fresh metrics + events + profiler counters for a new run (trace
+    recorder unchanged: its lifetime is the CLI invocation, managed by
+    start/stop; the profiler's compiled caches survive too)."""
     metrics.reset()
     events.reset()
+    profile.reset()
 
 
 def finalize(subcommand: str,
@@ -61,6 +63,16 @@ def finalize(subcommand: str,
                            "; ".join(problems[:5]))
         if report_path:
             report_mod.write(report_path, out)
+        # Feed the cross-run perf ledger (docs/observability.md):
+        # one appended line per finalized run when GALAH_OBS_LEDGER
+        # names a path, keyed by backend/topology/workload/strategy.
+        from galah_tpu.config import env_value
+
+        ledger_path = env_value("GALAH_OBS_LEDGER")
+        if ledger_path:
+            from galah_tpu.obs import ledger as ledger_mod
+
+            ledger_mod.record_report(ledger_path, out, subcommand)
     except Exception:
         logger.warning("run report assembly failed", exc_info=True)
     finally:

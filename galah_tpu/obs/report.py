@@ -26,7 +26,7 @@ logger = logging.getLogger(__name__)
 
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
                            "run_report.schema.json")
-REPORT_VERSION = 2
+REPORT_VERSION = 3
 
 # disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
 _DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
@@ -168,6 +168,12 @@ def assemble(subcommand: str,
         "metrics": metrics,
         "events": obs_events.snapshot(),
     }
+    try:
+        from galah_tpu.obs import profile as obs_profile
+
+        report["device_costs"] = obs_profile.snapshot()
+    except Exception:  # device costs are additive; never lose a report
+        logger.debug("device-cost snapshot failed", exc_info=True)
     if lint is not None:
         report["lint"] = lint
     return report
@@ -289,6 +295,34 @@ def render(report: dict) -> str:
             lines.append(f"    {ev.get('kind')}: {extra}")
         if len(events) > 20:
             lines.append(f"    ... {len(events) - 20} more")
+    dc = report.get("device_costs")
+    if dc and dc.get("entries"):
+        peaks = dc.get("peaks", {})
+        hbm = dc.get("hbm", {})
+        lines += ["", "device costs (profiled entry points):"]
+        if peaks.get("device_kind"):
+            pk = peaks.get("peak_flops_per_s")
+            lines.append(
+                f"  device kind: {peaks['device_kind']}"
+                + (f" (peak {pk:.3g} FLOP/s)" if pk else ""))
+        if hbm.get("peak_bytes") is not None:
+            lines.append(
+                f"  HBM high-water: {hbm['peak_bytes'] / 2**20:.1f} "
+                f"MiB ({hbm.get('source')})")
+        for name, e in sorted(dc["entries"].items()):
+            flops = e.get("flops")
+            byts = e.get("bytes_accessed")
+            util = e.get("flops_utilization")
+            parts = [f"calls={e.get('calls', 0)}",
+                     f"compile={_fmt_s(e.get('compile_wall_s', 0.0))}",
+                     f"dispatch={_fmt_s(e.get('dispatch_wall_s', 0.0))}"]
+            if flops:
+                parts.append(f"flops={flops:.3g}")
+            if byts:
+                parts.append(f"bytes={byts:.3g}")
+            if util is not None:
+                parts.append(f"mxu={100.0 * util:.2f}%")
+            lines.append(f"  {name}: " + " ".join(parts))
     lint = report.get("lint")
     if lint is not None:
         fams = ", ".join(f"{fam}={n}" for fam, n in
@@ -389,6 +423,29 @@ def diff(a: dict, b: dict, label_a: str = "A",
     rb = {d["site"] for d in b.get("resilience", {}).get("demotions", [])}
     if ra != rb:
         lines += ["", f"demotions: {sorted(ra)} -> {sorted(rb)}"]
+
+    # device-cost drift — .get throughout so a v2/v3 pair still diffs
+    da = (a.get("device_costs") or {}).get("entries") or {}
+    db = (b.get("device_costs") or {}).get("entries") or {}
+    if da or db:
+        lines += ["", "device costs:"]
+        ha = ((a.get("device_costs") or {}).get("hbm")
+              or {}).get("peak_bytes")
+        hb = ((b.get("device_costs") or {}).get("hbm")
+              or {}).get("peak_bytes")
+        if ha is not None or hb is not None:
+            lines.append(f"  hbm_peak_bytes: {ha} -> {hb}")
+        for name in sorted(set(da) | set(db)):
+            ea, eb = da.get(name, {}), db.get(name, {})
+            for field in ("dispatch_wall_s", "compile_wall_s",
+                          "calls"):
+                va, vb = ea.get(field), eb.get(field)
+                if va is None and vb is None:
+                    continue
+                delta = ("" if va is None or vb is None
+                         else f" ({vb - va:+.6g})")
+                lines.append(
+                    f"  {name}.{field}: {va} -> {vb}{delta}")
 
     la, lb = a.get("lint"), b.get("lint")
     if la is not None or lb is not None:

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from galah_tpu.obs.profile import profiled
 from galah_tpu.utils import timing
 
 jax.config.update("jax_enable_x64", True)
@@ -111,6 +112,7 @@ def _bucket(n: int) -> int:
     return b
 
 
+@profiled("greedy.window_select")
 @jax.jit
 def _window_select_jit(ani: jax.Array, ext: jax.Array, valid: jax.Array,
                        thr: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -146,6 +148,7 @@ def _window_select_jit(ani: jax.Array, ext: jax.Array, valid: jax.Array,
     return rep, undecided
 
 
+@profiled("greedy.membership_argmax")
 @jax.jit
 def _membership_argmax_jit(ani: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Per-row argmax over the (non-rep x rep) candidate ANI matrix.

@@ -66,6 +66,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from galah_tpu.obs.profile import profiled
 from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.pallas_pairwise import _zi
 from galah_tpu.utils import timing
@@ -202,8 +203,8 @@ def _window_hits_jit(
     )(q_hi, q_lo, r_hi, r_lo)
 
 
-_window_hits = jax.jit(_window_hits_jit,
-                       static_argnames=("span", "interpret"))
+_window_hits = profiled("fragment.window_hits")(
+    jax.jit(_window_hits_jit, static_argnames=("span", "interpret")))
 
 
 def _bucket_jobs(n: int) -> int:

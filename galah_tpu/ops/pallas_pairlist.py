@@ -65,6 +65,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from galah_tpu.obs.profile import profiled
+
 from galah_tpu.ops.pallas_pairwise import (
     _inclusive_cumsum_axis0,
     _inclusive_cumsum_axis1,
@@ -393,6 +395,7 @@ def pair_stats_pairs_pallas(
     return common[:b_in], total[:b_in]
 
 
+@profiled("pairlist.pair_stats_pairs")
 @functools.partial(jax.jit,
                    static_argnames=("sketch_size", "interpret",
                                     "range_skip", "block_pairs",

@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from galah_tpu.obs.profile import profiled
+
 A_SUB = 8     # a-chunk height: consecutive sketch values per lane column
 B_LANE = 128  # b-chunk width: consecutive sketch values per sublane row
 ROWS_PER_PROGRAM = 8
@@ -299,6 +301,7 @@ def _split_planes(mat: jax.Array) -> Tuple[jax.Array, jax.Array]:
             mat.astype(jnp.uint32))
 
 
+@profiled("pairwise.tile_stats_pallas")
 @functools.partial(jax.jit,
                    static_argnames=("sketch_size", "interpret",
                                     "intersect", "range_skip"))

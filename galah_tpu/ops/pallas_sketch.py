@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from galah_tpu.obs.profile import profiled
+
 LANES = 128
 BLOCK_SUB = 512  # sublanes per grid program (block = BLOCK_SUB x 128)
 
@@ -209,6 +211,7 @@ def _zi(i):
     return i * 0
 
 
+@profiled("sketch.murmur3_k21_pallas")
 @functools.partial(jax.jit, static_argnames=("seed", "interpret"))
 def murmur3_k21_pallas(
     k1: jax.Array,    # uint64 (n,): bytes 0-7 of the canonical k-mer

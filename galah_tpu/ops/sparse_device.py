@@ -36,6 +36,7 @@ import numpy as np
 from galah_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from galah_tpu.obs.profile import profiled
 from galah_tpu.ops.constants import SENTINEL
 from galah_tpu.ops.pairwise import (
     _pair_stats,
@@ -119,6 +120,7 @@ def pair_block_quantum() -> int:
     return pairlist_block_pairs()
 
 
+@profiled("sparse.batch_pair_stats")
 @functools.partial(
     jax.jit,
     static_argnames=("sketch_size", "use_pallas", "interpret"))
@@ -219,6 +221,7 @@ def _plan_gather_segments(spi: np.ndarray, spj: np.ndarray,
     return segments, cells
 
 
+@profiled("sparse.gather_tile_stats")
 @functools.partial(jax.jit,
                    static_argnames=("sketch_size", "interpret"))
 def _gather_tile_stats(jmat: jax.Array, ua: jax.Array, ub: jax.Array,

@@ -26,6 +26,12 @@ ART=/root/repo/docs/artifacts/tpu_watch_$(date -u +%Y%m%d_%H%M)
 cd /root/repo
 echo "=== tpu_validation_run (tunnel lock held) $(date -u) ===" >> "$LOG"
 
+# Cross-run perf ledger: every stage's finalized run report appends
+# one entry (galah_tpu/obs/ledger.py), so hardware sessions build the
+# history `galah-tpu perf check` gates on. The ledger lives outside
+# the capture dir — it spans sessions by design.
+export GALAH_OBS_LEDGER=${GALAH_OBS_LEDGER:-/root/repo/perf_ledger.jsonl}
+
 for attempt in $(seq 1 60); do
   t0=$(date +%s)
   # 240 s: a slow-but-alive tunnel can take minutes to attach after an
@@ -89,6 +95,13 @@ run_stage bench "$BENCH_TIMEOUT" env \
 # wedge and lands in its own artifact).
 run_stage engine_rounds 900 python -u scripts/bench_engine_rounds.py \
   --budget 840
+# Perf gate right after the bench stages: the newest ledger entries
+# (appended by the bench/engine finalizers above) against their
+# same-key median±MAD bands. --soft while hardware history is still
+# accumulating: regressions are REPORTED in the capture, not yet
+# fatal to the session — flip to hard gating once each key carries a
+# trustworthy window (docs/observability.md).
+run_stage perf_check 120 python -u -m galah_tpu.cli perf check --soft
 run_stage kernel_variants 1200 python -u scripts/bench_kernel_variants.py
 run_stage sketch_variants 1200 python -u scripts/bench_sketch_variants.py
 run_stage ladder_tpu 3600 python -u scripts/ladder_bench.py --n 1000 \

@@ -1,0 +1,25 @@
+"""Fixture: ad-hoc device-cost introspection in a pipeline module.
+
+Loaded by tests/test_analysis.py at a synthetic galah_tpu/ops/ path;
+never imported. GL703 must flag the direct memory_stats() and
+cost_analysis() calls; the suppressed line must survive with a
+justification; the unrelated same-name *attribute access* (no call)
+and a method defined locally must not fire.
+"""
+import jax
+
+
+def snoop(fn, x):
+    dev = jax.devices()[0]
+    stats = dev.memory_stats()  # line 14: GL703
+    compiled = fn.lower(x).compile()
+    costs = compiled.cost_analysis()  # line 16: GL703
+    ok = dev.memory_stats  # attribute access only: no finding
+    # galah-lint: ignore[GL703] one-off capacity probe, not telemetry
+    probe = dev.memory_stats()
+    return stats, costs, ok, probe
+
+
+class NotADevice:
+    def memory_stats(self):  # defining the method is fine
+        return {}

@@ -380,3 +380,87 @@ def test_flag_snapshot_marks_env_set(monkeypatch):
     assert snap["GALAH_OBS_REPORT"]["value"] == "/tmp/r.json"
     assert snap["GALAH_OBS_TRACE_EVENTS"]["set"] is False
     assert snap["GALAH_OBS_TRACE_EVENTS"]["section"] == "observability"
+
+
+# -- schema v3: device_costs section ---------------------------------
+
+
+def test_report_v3_carries_populated_device_costs():
+    """A run that dispatched through a @profiled entry point must land
+    cost/wall numbers in the report's device_costs section — the
+    section the perf ledger reads its profile.* metrics from."""
+    jsonschema = pytest.importorskip("jsonschema")
+    import jax.numpy as jnp
+
+    from galah_tpu.obs import profile as obs_profile
+    from galah_tpu.obs.profile import profiled
+
+    import jax
+
+    fn = profiled("test.v3_entry")(jax.jit(lambda x: x * 2.0 + 1.0))
+    with timing.stage("precluster-distances"):
+        for _ in range(3):
+            fn(jnp.ones((8, 8), jnp.float32))
+    rep = report_mod.assemble("cluster", started_at=0.0)
+    assert rep["version"] == 3
+    dc = rep["device_costs"]
+    assert dc["profiling_enabled"] is True
+    entry = dc["entries"]["test.v3_entry"]
+    assert entry["calls"] == 3
+    assert entry["signatures"] == 1
+    assert entry["flops"] > 0
+    assert dc["hbm"]["peak_bytes"] > 0
+    assert dc["hbm"]["source"] in ("memory_stats", "live_arrays")
+    assert report_mod.validate(rep) == []
+    with open(report_mod.SCHEMA_PATH) as fh:
+        jsonschema.Draft7Validator(json.load(fh)).validate(rep)
+    page = report_mod.render(rep)
+    assert "device costs" in page
+    assert "test.v3_entry" in page
+    # drop the one registry entry this test added
+    obs_profile._REGISTRY[:] = [
+        f for f in obs_profile._REGISTRY if f.name != "test.v3_entry"]
+
+
+def test_profile_disabled_flag_yields_plain_calls(monkeypatch):
+    import jax.numpy as jnp
+
+    from galah_tpu.obs import profile as obs_profile
+    from galah_tpu.obs.profile import profiled
+
+    monkeypatch.setenv("GALAH_OBS_PROFILE", "0")
+    fn = profiled("test.disabled_entry")(lambda x: x + 1)
+    assert float(fn(jnp.float32(1.0))) == 2.0  # still correct
+    snap = obs_profile.snapshot()
+    assert snap["profiling_enabled"] is False
+    assert "test.disabled_entry" not in snap["entries"]
+    obs_profile._REGISTRY[:] = [
+        f for f in obs_profile._REGISTRY
+        if f.name != "test.disabled_entry"]
+
+
+def test_report_diff_v2_v3_is_additive_compatible(tmp_path, capsys):
+    """`report --diff` across a v2 report (no device_costs) and a v3
+    report must not crash — the section is optional and additive."""
+    from galah_tpu.cli import main
+
+    _populate_run_state()
+    v3 = report_mod.assemble("cluster", started_at=0.0)
+    v3.setdefault("device_costs", {"profiling_enabled": True,
+                                   "entries": {}, "hbm": {
+                                       "peak_bytes": 0, "source": None,
+                                       "per_stage": {}},
+                                   "peaks": None})
+    v2 = json.loads(json.dumps(v3))
+    del v2["device_costs"]
+    v2["version"] = 2
+    pa, pb = tmp_path / "v2.json", tmp_path / "v3.json"
+    pa.write_text(json.dumps(v2))
+    pb.write_text(json.dumps(v3))
+    # v2 stays schema-valid (the enum admits both) and diff runs both
+    # directions without touching the missing section
+    assert report_mod.validate(v2) == []
+    assert main(["report", "--diff", str(pa), str(pb)]) == 0
+    assert main(["report", "--diff", str(pb), str(pa)]) == 0
+    out = capsys.readouterr().out
+    assert "galah-tpu report diff" in out or out  # rendered, no crash
