@@ -14,6 +14,8 @@ Checker families
   GL6xx  hardware-test marker audit
   GL7xx  observability discipline (ad-hoc timing outside obs/)
   GL8xx  concurrency discipline (GUARDED_BY/LOCK_ORDER annotations)
+         and durable-write discipline (GL806: durable artifacts are
+         written only through io/atomic.py)
   GL9xx  numeric determinism (DETERMINISM_CONTRACT annotations)
 
 Suppression: ``# galah-lint: ignore[GL103]`` on the flagged line or
@@ -36,7 +38,8 @@ from galah_tpu.analysis import core
 from galah_tpu.analysis.core import Finding, Severity, SourceFile
 
 CHECK_NAMES = ("pallas", "runtime", "flags", "markers", "shapes",
-               "obs", "concurrency", "determinism", "suppressions")
+               "obs", "concurrency", "fs", "determinism",
+               "suppressions")
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
                                 "baseline.json")
 
@@ -89,6 +92,10 @@ def run_checks(sources: Dict[str, SourceFile],
         from galah_tpu.analysis.concurrency_check import \
             check_concurrency
         findings.extend(check_concurrency(sources))
+    if "fs" in checks:
+        from galah_tpu.analysis.fs_check import check_fs_file
+        for src in sources.values():
+            findings.extend(check_fs_file(src))
     if "determinism" in checks:
         from galah_tpu.analysis.determinism_check import \
             check_determinism_file

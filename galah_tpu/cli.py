@@ -101,6 +101,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Persist the distance pass and finished "
                         "preclusters here; an interrupted run resumes "
                         "from the last completed precluster")
+    c.add_argument("--resume", action="store_true",
+                   help="Require resuming from --checkpoint-dir: fail "
+                        "if the checkpoint is missing or belongs to a "
+                        "different configuration instead of silently "
+                        "starting fresh. Without this flag a matching "
+                        "checkpoint still auto-resumes; --resume makes "
+                        "\"no checkpoint\" an error. The run report's "
+                        "preemption section records the resume chain")
     c.add_argument("--output-cluster-definition",
                    help="Output file of rep<TAB>member lines")
     c.add_argument("--output-representative-fasta-directory",
@@ -313,6 +321,7 @@ def run_cluster(args) -> int:
 
     from galah_tpu import obs
     from galah_tpu.config import env_value
+    from galah_tpu.resilience import interrupt
 
     # Telemetry lifecycle brackets the whole run: reset shared state,
     # open the trace sink if requested, and always finalize (write the
@@ -322,6 +331,12 @@ def run_cluster(args) -> int:
     started_at = _time.time()  # galah-lint: ignore[GL701]
     timing.reset()
     obs.reset_run()
+    # Cooperative preemption: SIGTERM/SIGINT request a stop at the next
+    # safe boundary (engine round edges / checkpoint flushes); the
+    # finalize below then drains the report/ledger/trace writers before
+    # the process exits with EXIT_PREEMPTED.
+    interrupt.reset()
+    interrupt.install()
     trace_path = (getattr(args, "trace_events", None)
                   or env_value("GALAH_OBS_TRACE_EVENTS"))
     if trace_path:
@@ -331,6 +346,7 @@ def run_cluster(args) -> int:
     try:
         return _run_cluster_inner(args)
     finally:
+        interrupt.uninstall()
         obs.finalize("cluster", report_path=report_path,
                      started_at=started_at)
 
@@ -402,11 +418,17 @@ def _run_cluster_inner(args) -> int:
         handles = None
 
     ckpt = None
+    if getattr(args, "resume", False) \
+            and not getattr(args, "checkpoint_dir", None):
+        logger.error("--resume requires --checkpoint-dir")
+        return 1
     if getattr(args, "checkpoint_dir", None):
         from galah_tpu.cluster.checkpoint import (
             ClusterCheckpoint,
-            run_fingerprint,
+            fields_digest,
+            fingerprint_fields,
         )
+        from galah_tpu.resilience import interrupt
 
         # Multi-host: each process persists under its own subdirectory
         # — N processes appending to one shared checkpoint would
@@ -419,16 +441,29 @@ def _run_cluster_inner(args) -> int:
 
             ckpt_dir = _os.path.join(
                 ckpt_dir, f"proc_{distributed.process_index()}")
+        fields = fingerprint_fields(
+            genomes, args.precluster_method, args.cluster_method,
+            parse_percentage(args.ani, "--ani"),
+            parse_percentage(args.precluster_ani, "--precluster-ani"),
+            min_aligned_fraction=parse_percentage(
+                args.min_aligned_fraction, "--min-aligned-fraction"),
+            fragment_length=args.fragment_length,
+            backend_params=clusterer.backend_params)
         ckpt = ClusterCheckpoint(
-            ckpt_dir,
-            run_fingerprint(
-                genomes, args.precluster_method, args.cluster_method,
-                parse_percentage(args.ani, "--ani"),
-                parse_percentage(args.precluster_ani, "--precluster-ani"),
-                min_aligned_fraction=parse_percentage(
-                    args.min_aligned_fraction, "--min-aligned-fraction"),
-                fragment_length=args.fragment_length,
-                backend_params=clusterer.backend_params))
+            ckpt_dir, fields_digest(fields), fields=fields,
+            require_match=getattr(args, "resume", False))
+        # Resume chain for the run report: a matching checkpoint with
+        # recorded interruptions means this run continues a preempted
+        # one (whether or not --resume was passed).
+        prior = ckpt.load_interruptions()
+        if ckpt.matched_existing and (prior
+                                      or getattr(args, "resume",
+                                                 False)):
+            from galah_tpu.obs import events
+
+            interrupt.note_resume(ckpt_dir, len(prior))
+            events.record("resumed", checkpoint_dir=ckpt_dir,
+                          prior_interruptions=len(prior))
         # All-or-nothing resume across hosts: a crash can land between
         # two hosts' checkpoint saves, and resuming from uneven state
         # would deadlock the collective-participating distance pass
@@ -443,9 +478,38 @@ def _run_cluster_inner(args) -> int:
             ckpt.reset_state()
         clusterer.checkpoint = ckpt
 
+    from galah_tpu.resilience import interrupt
+
     logger.info("Clustering %d genomes ..", len(genomes))
-    with timing.trace_context(getattr(args, "profile_trace_dir", None)):
-        clusters = clusterer.cluster()
+    try:
+        with timing.trace_context(
+                getattr(args, "profile_trace_dir", None)):
+            clusters = clusterer.cluster()
+    except interrupt.PreemptionRequested as e:
+        # Cooperative preemption: everything before the boundary is
+        # already durable, so record the interruption, emit the event,
+        # and exit EXIT_PREEMPTED — obs.finalize (run_cluster) drains
+        # the report/trace/ledger writers on the way out.
+        import time as _time
+
+        from galah_tpu.obs import events
+
+        events.record("preempted", signal=e.signame,
+                      boundary=e.boundary)
+        if ckpt is not None:
+            ckpt.record_interruption({
+                "signal": e.signame,
+                "boundary": e.boundary,
+                # wall-clock stamp for the chain record, not a duration
+                "ts": _time.time(),  # galah-lint: ignore[GL701]
+            })
+        logger.warning(
+            "Preempted (%s): stopped at safe boundary %r. The "
+            "checkpoint%s is consistent; rerun with --resume to "
+            "continue. Exiting %d.", e.signame, e.boundary,
+            f" at {ckpt.path}" if ckpt is not None else "",
+            interrupt.EXIT_PREEMPTED)
+        return interrupt.EXIT_PREEMPTED
     logger.info("Found %d genome clusters", len(clusters))
 
     if is_writer:

@@ -23,11 +23,12 @@ import hashlib
 import json
 import logging
 import os
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from galah_tpu.cluster.cache import PairDistanceCache
+from galah_tpu.io import atomic
 
 logger = logging.getLogger(__name__)
 
@@ -35,6 +36,41 @@ _FINGERPRINT = "fingerprint.json"
 _DISTANCES = "precluster_distances.npz"
 _CLUSTERS = "clusters.jsonl"
 _GREEDY = "greedy_rounds.jsonl"
+_INTERRUPTIONS = "interruptions.jsonl"
+
+
+def fingerprint_fields(genomes: Sequence[str], precluster_method: str,
+                       cluster_method: str, ani: float,
+                       precluster_ani: float,
+                       min_aligned_fraction: float = 0.0,
+                       fragment_length: int = 0,
+                       backend_params: Optional[dict] = None
+                       ) -> Dict[str, Any]:
+    """The dict run_fingerprint hashes, also stored verbatim in
+    fingerprint.json so a mismatch can name WHICH field changed.
+
+    Genome paths are realpath-normalized first: `./a.fna`, `a.fna` and
+    an absolute path to the same file must produce the same
+    fingerprint, or a resume launched from a different cwd (or through
+    a symlinked data dir) silently discards a valid checkpoint."""
+    import galah_tpu
+
+    return {
+        "version": getattr(galah_tpu, "__version__", "0"),
+        "genomes": [os.path.realpath(g) for g in genomes],
+        "precluster_method": precluster_method,
+        "cluster_method": cluster_method,
+        "ani": ani,
+        "precluster_ani": precluster_ani,
+        "min_aligned_fraction": min_aligned_fraction,
+        "fragment_length": fragment_length,
+        "backend_params": backend_params or {},
+    }
+
+
+def fields_digest(fields: Dict[str, Any]) -> str:
+    return hashlib.sha256(
+        json.dumps(fields, sort_keys=True).encode()).hexdigest()
 
 
 def run_fingerprint(genomes: Sequence[str], precluster_method: str,
@@ -49,47 +85,80 @@ def run_fingerprint(genomes: Sequence[str], precluster_method: str,
     sketch_size/k/seed, HLL p, marker-screen threshold, ...) so a resume
     under different sketching parameters starts fresh; the tool version
     is always included since kernel changes can shift distances."""
-    import galah_tpu
-
-    ident = json.dumps({
-        "version": getattr(galah_tpu, "__version__", "0"),
-        "genomes": list(genomes),
-        "precluster_method": precluster_method,
-        "cluster_method": cluster_method,
-        "ani": ani,
-        "precluster_ani": precluster_ani,
-        "min_aligned_fraction": min_aligned_fraction,
-        "fragment_length": fragment_length,
-        "backend_params": backend_params or {},
-    }, sort_keys=True)
-    return hashlib.sha256(ident.encode()).hexdigest()
+    return fields_digest(fingerprint_fields(
+        genomes, precluster_method, cluster_method, ani,
+        precluster_ani, min_aligned_fraction, fragment_length,
+        backend_params))
 
 
 class ClusterCheckpoint:
     """One run's resumable state under `path` (None disables)."""
 
-    def __init__(self, path: Optional[str], fingerprint: str) -> None:
+    def __init__(self, path: Optional[str], fingerprint: str,
+                 fields: Optional[Dict[str, Any]] = None,
+                 require_match: bool = False) -> None:
         self.path = path
         self.fingerprint = fingerprint
+        self.fields = fields
+        self.matched_existing = False
         if not path:
             return
         os.makedirs(path, exist_ok=True)
+        # a writer killed mid-write leaves *.tmp debris; the checkpoint
+        # dir is single-owner, so sweep unconditionally at open
+        atomic.sweep_tmp(path)
         fp_file = os.path.join(path, _FINGERPRINT)
+        stored: Dict[str, Any] = {}
         if os.path.exists(fp_file):
-            with open(fp_file) as f:
-                existing = json.load(f).get("fingerprint")
-            if existing != fingerprint:
-                logger.warning(
-                    "Checkpoint at %s belongs to a different run "
-                    "configuration; starting fresh", path)
+            try:
+                with open(fp_file) as f:
+                    stored = json.load(f)
+            except (OSError, ValueError):
+                stored = {}
+            existing = stored.get("fingerprint")
+            if existing == fingerprint:
+                self.matched_existing = True
+            else:
+                self._log_mismatch(stored.get("fields"))
+                if require_match:
+                    raise ValueError(
+                        f"--resume: checkpoint at {path} belongs to a "
+                        f"different run configuration (fingerprint "
+                        f"{existing!r} != {fingerprint!r})")
                 for name in (_FINGERPRINT, _DISTANCES, _CLUSTERS,
-                             _GREEDY):
+                             _GREEDY, _INTERRUPTIONS):
                     try:
                         os.unlink(os.path.join(path, name))
                     except FileNotFoundError:
                         pass
-        with open(fp_file, "w") as f:
-            json.dump({"fingerprint": fingerprint}, f)
+        elif require_match:
+            raise ValueError(
+                f"--resume: no checkpoint fingerprint at {path}")
+        if (not self.matched_existing
+                or (fields is not None
+                    and stored.get("fields") != fields)):
+            atomic.write_json(fp_file, {"fingerprint": fingerprint,
+                                        "fields": fields})
+
+    def _log_mismatch(self, stored_fields: Optional[Dict[str, Any]]
+                      ) -> None:
+        """Name the fields that differ — "fingerprint mismatch" alone
+        sends operators diffing sha256 inputs by hand."""
+        if stored_fields and self.fields:
+            diffs = [k for k in sorted(set(stored_fields)
+                                       | set(self.fields))
+                     if stored_fields.get(k) != self.fields.get(k)]
+            logger.warning(
+                "Checkpoint at %s belongs to a different run "
+                "configuration (mismatched fields: %s); starting fresh",
+                self.path, ", ".join(diffs) or "<unknown>")
+            for k in diffs:
+                logger.warning("  %s: checkpoint=%r, run=%r", k,
+                               stored_fields.get(k), self.fields.get(k))
+        else:
+            logger.warning(
+                "Checkpoint at %s belongs to a different run "
+                "configuration; starting fresh", self.path)
 
     @property
     def enabled(self) -> bool:
@@ -159,10 +228,10 @@ class ClusterCheckpoint:
                            dtype=bool)
         vals = np.array([cache.get(k) or 0.0 for k in keys],
                         dtype=np.float64)
-        tmp = os.path.join(self.path, _DISTANCES + ".tmp")
-        with open(tmp, "wb") as f:
-            np.savez(f, ii=ii, jj=jj, vals=vals, has_val=has_val)
-        os.replace(tmp, os.path.join(self.path, _DISTANCES))
+        atomic.write_npz(os.path.join(self.path, _DISTANCES),
+                         {"ii": ii, "jj": jj, "vals": vals,
+                          "has_val": has_val},
+                         site="io.atomic.write[ckpt.distances]")
         logger.info("Checkpointed precluster distances (%d pairs)",
                     len(cache))
 
@@ -174,23 +243,15 @@ class ClusterCheckpoint:
         if not self.enabled:
             return out
         fn = os.path.join(self.path, _CLUSTERS)
-        if not os.path.exists(fn):
-            return out
-        with open(fn) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    # torn tail from a kill mid-write: drop it (that
-                    # precluster just recomputes) rather than failing
-                    # the resume
-                    logger.warning(
-                        "Dropping torn checkpoint record in %s", fn)
-                    continue
-                out[int(rec["precluster"])] = rec["clusters"]
+        records, bad = atomic.read_jsonl(fn)
+        if bad:
+            # torn tail from a kill mid-write: drop it (that
+            # precluster just recomputes) rather than failing resume
+            logger.warning(
+                "Dropped %d torn checkpoint record(s) (torn tail or "
+                "corrupt frame) in %s", bad, fn)
+        for rec in records:
+            out[int(rec["precluster"])] = rec["clusters"]
         if out:
             logger.info("Resuming: %d preclusters already clustered",
                         len(out))
@@ -200,11 +261,9 @@ class ClusterCheckpoint:
                         clusters: List[List[int]]) -> None:
         if not self.enabled:
             return
-        with open(os.path.join(self.path, _CLUSTERS), "a") as f:
-            f.write(json.dumps({"precluster": index,
-                                "clusters": clusters}) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        atomic.append_jsonl(os.path.join(self.path, _CLUSTERS),
+                            {"precluster": index, "clusters": clusters},
+                            site="io.atomic.append[ckpt.clusters]")
 
     # -- greedy phase, per-round (device strategy) --------------------
     #
@@ -223,26 +282,19 @@ class ClusterCheckpoint:
         if not self.enabled:
             return out
         fn = os.path.join(self.path, _GREEDY)
-        if not os.path.exists(fn):
-            return out
-        with open(fn) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    # torn tail from a kill mid-write: that round just
-                    # recomputes its pairs
-                    logger.warning(
-                        "Dropping torn greedy-round record in %s", fn)
-                    continue
-                if rec.get("digest") != digest:
-                    continue
-                for i, j, ani in rec["pairs"]:
-                    out.append((int(i), int(j),
-                                float(ani) if ani is not None else None))
+        records, bad = atomic.read_jsonl(fn)
+        if bad:
+            # torn tail from a kill mid-write: that round just
+            # recomputes its pairs
+            logger.warning(
+                "Dropped %d torn/corrupt greedy-round record(s) in %s",
+                bad, fn)
+        for rec in records:
+            if rec.get("digest") != digest:
+                continue
+            for i, j, ani in rec["pairs"]:
+                out.append((int(i), int(j),
+                            float(ani) if ani is not None else None))
         if out:
             logger.info("Resuming: replaying %d greedy-round ANI pairs",
                         len(out))
@@ -252,12 +304,11 @@ class ClusterCheckpoint:
                           pairs: List[tuple]) -> None:
         if not self.enabled:
             return
-        rec = {"digest": digest,
-               "pairs": [[i, j, ani] for i, j, ani in pairs]}
-        with open(os.path.join(self.path, _GREEDY), "a") as f:
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        atomic.append_jsonl(
+            os.path.join(self.path, _GREEDY),
+            {"digest": digest,
+             "pairs": [[i, j, ani] for i, j, ani in pairs]},
+            site="io.atomic.append[ckpt.greedy]")
 
     def clear_greedy_rounds(self) -> None:
         """Drop the round log once its preclusters have all been saved
@@ -268,3 +319,29 @@ class ClusterCheckpoint:
             os.unlink(os.path.join(self.path, _GREEDY))
         except FileNotFoundError:
             pass
+
+    # -- interruption / resume chain ----------------------------------
+    #
+    # One record per cooperative preemption, appended by the CLI as it
+    # exits with EXIT_PREEMPTED. A resume reads the chain to report
+    # `resumed_from` and how many interruptions preceded it
+    # (run_report.json "preemption" section); the chaos harness asserts
+    # the chain is present and consistent after every kill/resume.
+
+    def record_interruption(self, info: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        atomic.append_jsonl(os.path.join(self.path, _INTERRUPTIONS),
+                            info,
+                            site="io.atomic.append[ckpt.interrupts]")
+
+    def load_interruptions(self) -> List[Dict[str, Any]]:
+        if not self.enabled:
+            return []
+        records, bad = atomic.read_jsonl(
+            os.path.join(self.path, _INTERRUPTIONS))
+        if bad:
+            logger.warning(
+                "Dropped %d torn interruption record(s) in %s", bad,
+                self.path)
+        return records

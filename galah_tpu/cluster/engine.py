@@ -35,6 +35,7 @@ if TYPE_CHECKING:
 from galah_tpu.backends.base import ClusterBackend, PreclusterBackend
 from galah_tpu.cluster.cache import PairDistanceCache, pair_key
 from galah_tpu.cluster.partition import partition_preclusters
+from galah_tpu.resilience import interrupt
 from galah_tpu.utils import timing
 
 logger = logging.getLogger(__name__)
@@ -143,6 +144,9 @@ def cluster(
         obs_profile.sample_memory("precluster-distances")
         if checkpoint:
             checkpoint.save_distances(pre_cache)
+    # safe boundary: the distance pass (the single biggest recompute)
+    # has just reached disk — a preemption here resumes past it
+    interrupt.check("distances-saved")
 
     logger.info("Preclustering ..")
     with timing.stage("partition"):
@@ -168,6 +172,8 @@ def cluster(
                 device_done = _cluster_pending_rounds(
                     clusterer, genomes, pre_cache, pending,
                     skip_clusterer, checkpoint, rep_rounds)
+            except interrupt.PreemptionRequested:
+                raise  # a stop request is never a demotion signal
             except Exception as e:  # noqa: BLE001 - AUTO demotes
                 if explicit:
                     raise
@@ -232,6 +238,9 @@ def cluster(
             all_clusters.extend(global_clusters)
             if checkpoint:
                 checkpoint.save_precluster(pc_index, global_clusters)
+            # safe boundary: this precluster's clusters are durable —
+            # a resume recomputes only the preclusters after it
+            interrupt.check("precluster-saved")
     obs_profile.sample_memory("greedy-cluster")
     logger.info("Found %d clusters", len(all_clusters))
     return all_clusters
@@ -566,6 +575,9 @@ def _cluster_pending_rounds(
                     digest,
                     [(i, j, ani_cache.get((i, j)))
                      for i, j in computed[rstart:]])
+        # safe boundary: this round's ANI pairs are durable — a
+        # resume replays them and re-derives the decisions for free
+        interrupt.check("greedy-round-saved")
 
     # -- membership: one global batched dispatch + jitted argmax ------
     todo: List[Tuple[int, int]] = []

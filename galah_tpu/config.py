@@ -210,8 +210,13 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
     # -- resilience --------------------------------------------------------
     Flag("GALAH_FI", kind="grammar", section="resilience",
          help="Deterministic fault injection, e.g. "
-              "'site=dispatch.ani;kind=raise;prob=0.3;seed=7;max=2' "
-              "(docs/resilience.md)"),
+              "'site=dispatch.ani;kind=raise;prob=0.3;seed=7;max=2'. "
+              "Dispatch kinds: raise, device-lost, hang, garbage. "
+              "Filesystem kinds (fire inside io/atomic.py at "
+              "io.atomic.* sites): enospc, eio, torn-write, slow-io. "
+              "'kill' fires at any site and os._exit()s the process "
+              "mid-operation (the chaos harness primitive, "
+              "scripts/chaos_run.py). See docs/resilience.md"),
 ) + _retry_family(
     "GALAH_RETRY", "Device-dispatch retry policy"
 ) + _retry_family(

@@ -1,0 +1,302 @@
+"""The single durable-write primitive for every crash-surviving artifact.
+
+Before this module, five call sites hand-rolled their own tmp+rename
+idiom with inconsistent fsync discipline (diskcache entries, the
+quarantine manifest, checkpoint fingerprint/distances, run reports, the
+perf-ledger append) — and none of them fsynced the parent directory, so
+a host crash could lose the rename itself. A run killed at an arbitrary
+instant (preemptible TPU slices, `kill -9`, ENOSPC mid-write) must
+leave every durable artifact either absent, fully old, or fully new —
+never torn. This module is the one place that guarantee lives:
+
+  * whole-file artifacts (``write_bytes`` / ``write_text`` /
+    ``write_json`` / ``write_npz``): unique tmp in the same directory,
+    single write, ``fsync(file)``, ``os.replace``, ``fsync(dir)`` —
+    the rename is the commit point and it is itself made durable;
+  * append-only JSONL logs (``append_jsonl``): one ``O_APPEND``
+    ``write()`` per record with checksum framing
+    (``<compact-json>\\t<crc32hex>\\n``) and fsync — ``read_jsonl``
+    verifies the checksum, tolerates torn tails and legacy unframed
+    lines, and ``append_jsonl`` self-heals a torn tail by terminating
+    it before the next record (so one crash never poisons the line
+    that follows it);
+  * ``sweep_tmp``: removes the ``*.tmp`` debris a killed writer left
+    behind (age-gated for shared directories like the sketch cache).
+
+Filesystem fault injection (GALAH_FI kinds ``enospc`` / ``eio`` /
+``torn-write`` / ``slow-io`` / ``kill``, docs/resilience.md) fires
+INSIDE these primitives, at named ``io.atomic.*`` sites — the chaos
+harness (scripts/chaos_run.py) uses it to prove the
+all-or-nothing claim by killing real runs mid-write.
+
+Import discipline: stdlib only at module import (numpy lazily inside
+``write_npz``, the fault injector lazily per call) — the perf-ledger
+and report paths run on hosts with no accelerator and must never drag
+jax in.
+
+Lint: the GL806 rule (analysis/fs_check.py) flags any write-mode
+``open()`` in the durable-artifact modules OUTSIDE this file, so new
+persistence code cannot quietly regress to a hand-rolled idiom.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import logging
+import os
+import tempfile
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: Frame separator between a JSONL payload and its crc32. A raw tab
+#: cannot appear in a compact json.dumps payload — control characters
+#: are always escaped in strings and the separators contain none — so
+#: rpartition on it is unambiguous. (Deliberately NOT \x1e/\x1c/\x1d:
+#: those are str.splitlines boundaries, and tooling that reads these
+#: logs line-wise would split one record into two "lines".)
+FRAME_SEP = "\t"
+
+#: Default age gate for sweep_tmp in SHARED directories (sketch cache):
+#: a .tmp younger than this may belong to a live concurrent writer.
+SHARED_TMP_MAX_AGE_S = 3600.0
+
+
+# ---------------------------------------------------------------------------
+# Fault injection hook
+# ---------------------------------------------------------------------------
+
+
+def _fs_fault(site: str) -> Optional[str]:
+    """Consult the GALAH_FI injector for filesystem faults at `site`.
+
+    enospc/eio raise the corresponding OSError here; kill never
+    returns (os._exit); slow-io sleeps; torn-write returns the kind so
+    the caller can tear its own write (only the writer knows what a
+    half-written record looks like)."""
+    from galah_tpu.resilience import faults
+
+    inj = faults.get_injector()
+    if inj is None:
+        return None
+    return inj.filesystem(site)
+
+
+def _site(default_kind: str, path: str, site: Optional[str]) -> str:
+    return site or f"io.atomic.{default_kind}[{os.path.basename(path)}]"
+
+
+# ---------------------------------------------------------------------------
+# Whole-file artifacts: tmp + fsync + rename + dir-fsync
+# ---------------------------------------------------------------------------
+
+
+def fsync_dir(path: str) -> None:
+    """Make a completed rename in `path` durable. Best-effort: some
+    filesystems refuse O_RDONLY directory fds — the rename is still
+    atomic there, only its durability window widens."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_bytes(path: str, data: bytes,
+                site: Optional[str] = None) -> None:
+    """Atomically replace `path` with `data`, durably.
+
+    Readers see the old content or the new content, never a mixture;
+    after return the new content survives power loss. On any failure
+    the injected-crash tmp debris (if torn) or nothing is left —
+    `path` itself is untouched."""
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    action = _fs_fault(_site("write", path, site))
+    fd, tmp = tempfile.mkstemp(dir=parent,
+                               prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        try:
+            if action == "torn-write":
+                # simulate a crash mid-write: half the payload reaches
+                # the tmp, no cleanup runs (sweep_tmp collects it), and
+                # the caller sees the write fail
+                os.write(fd, data[:len(data) // 2])
+                raise OSError(
+                    errno.EIO, f"injected torn write ({tmp})")
+            os.write(fd, data)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+    except OSError as e:
+        if action != "torn-write":
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        raise e
+    fsync_dir(parent)
+
+
+def write_text(path: str, text: str,
+               site: Optional[str] = None) -> None:
+    write_bytes(path, text.encode("utf-8"), site=site)
+
+
+def write_json(path: str, obj: Any, indent: Optional[int] = None,
+               site: Optional[str] = None) -> None:
+    write_bytes(
+        path,
+        (json.dumps(obj, indent=indent, sort_keys=True) + "\n").encode(
+            "utf-8"),
+        site=site)
+
+
+def write_npz(path: str, arrays: Dict[str, Any],
+              site: Optional[str] = None) -> None:
+    """Atomic .npz: serialized fully in memory, then one durable
+    write — a killed writer can never leave a half-zipped entry under
+    the final name."""
+    import io as _io
+
+    import numpy as np
+
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    write_bytes(path, buf.getvalue(), site=site)
+
+
+# ---------------------------------------------------------------------------
+# Append-only JSONL with checksum framing
+# ---------------------------------------------------------------------------
+
+
+def frame_line(obj: Any) -> str:
+    """One framed record: compact JSON + FRAME_SEP + crc32 + newline."""
+    payload = json.dumps(obj, sort_keys=True, separators=(",", ":"))
+    if "\n" in payload:  # defensive: a newline would tear the format
+        raise ValueError("JSONL records must serialize to one line")
+    crc = zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+    return f"{payload}{FRAME_SEP}{crc:08x}\n"
+
+
+def append_jsonl(path: str, obj: Any,
+                 site: Optional[str] = None) -> None:
+    """Durably append one checksum-framed record as a single write().
+
+    O_APPEND keeps concurrent appenders from interleaving inside a
+    record; the crc frame lets read_jsonl reject the torn tail a
+    mid-write kill leaves. If the existing tail is torn (no trailing
+    newline — the previous writer died mid-append), the new record is
+    prefixed with a newline so the torn bytes stay confined to their
+    own (checksum-rejected) line instead of corrupting this one."""
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    action = _fs_fault(_site("append", path, site))
+    data = frame_line(obj).encode("utf-8")
+    # O_RDWR (not O_WRONLY): the torn-tail probe pread()s the last byte
+    fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        size = os.fstat(fd).st_size
+        if size and os.pread(fd, 1, size - 1) != b"\n":
+            data = b"\n" + data
+        if action == "torn-write":
+            os.write(fd, data[:max(1, len(data) // 2)])
+            raise OSError(errno.EIO,
+                          f"injected torn append ({path})")
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def read_jsonl(path: str) -> Tuple[List[Any], int]:
+    """All intact records of `path` in file order, plus the count of
+    torn/corrupt lines skipped.
+
+    Framed lines (FRAME_SEP present) are checksum-verified; legacy
+    unframed lines (pre-framing checkpoints/ledgers) parse as plain
+    JSON. A missing file is an empty log. Never raises on content —
+    a crash mid-append must read as "one record short", not an error."""
+    if not os.path.exists(path):
+        return [], 0
+    records: List[Any] = []
+    bad = 0
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            # rstrip newlines ONLY: a write torn right after the frame
+            # separator must still look framed (and fail its crc), not
+            # have the trailing tab stripped and sneak past as legacy
+            line = line.rstrip("\r\n")
+            if not line.strip():
+                continue
+            if FRAME_SEP in line:
+                payload, _, crc_hex = line.rpartition(FRAME_SEP)
+                try:
+                    want = int(crc_hex, 16)
+                except ValueError:
+                    bad += 1
+                    continue
+                if (zlib.crc32(payload.encode("utf-8"))
+                        & 0xFFFFFFFF) != want:
+                    bad += 1
+                    continue
+                try:
+                    records.append(json.loads(payload))
+                except ValueError:
+                    bad += 1
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                bad += 1
+    return records, bad
+
+
+# ---------------------------------------------------------------------------
+# Crash-debris sweep
+# ---------------------------------------------------------------------------
+
+
+def sweep_tmp(directory: str, max_age_s: float = 0.0) -> int:
+    """Remove ``*.tmp`` files a killed writer left in `directory`;
+    returns how many were removed.
+
+    ``max_age_s`` guards shared directories: a .tmp younger than it
+    may belong to a live concurrent writer and is left alone (pass 0
+    for single-owner directories like a run's checkpoint dir)."""
+    import time
+
+    removed = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    # age gate, not a duration measurement
+    now = time.time()  # galah-lint: ignore[GL701] wall-clock age gate
+    for name in names:
+        if not name.endswith(".tmp"):
+            continue
+        p = os.path.join(directory, name)
+        try:
+            if max_age_s and now - os.stat(p).st_mtime < max_age_s:
+                continue
+            os.unlink(p)
+            removed += 1
+        except OSError:
+            continue
+    if removed:
+        logger.info("Swept %d stale .tmp file(s) from %s", removed,
+                    directory)
+    return removed

@@ -12,8 +12,14 @@ Design:
   * a cache entry is one ``.npz`` file under the cache directory, named
     by a SHA-256 of (absolute path, file size, mtime_ns, kind, params) —
     touching or replacing a FASTA invalidates its entries automatically;
-  * writes go through a temp file + ``os.replace`` so concurrent runs
-    sharing a cache directory never observe torn entries;
+  * writes go through io/atomic.py (tmp + fsync + rename + dir-fsync)
+    so concurrent runs sharing a cache directory never observe torn
+    entries, and a host crash can't lose a completed store;
+  * every entry embeds a content checksum (``__check__`` array); loads
+    verify it, and ANY unreadable/truncated/checksum-mismatched entry —
+    or ``.tmp`` debris from a killed writer — is miss-and-repair: drop
+    the file, recompute, restore. A corrupt cache can cost time, never
+    a wrong sketch;
   * the cache is strictly optional: ``CacheDir(None)`` is a no-op store,
     so call sites keep one code path.
 
@@ -27,12 +33,29 @@ import hashlib
 import json
 import logging
 import os
-import tempfile
+import zlib
 from typing import Dict, Optional
 
 import numpy as np
 
+from galah_tpu.io import atomic
+
 logger = logging.getLogger(__name__)
+
+#: Reserved entry member holding the content crc32 of all other arrays.
+_CHECK_KEY = "__check__"
+
+
+def _content_crc(arrays: Dict[str, np.ndarray]) -> int:
+    """crc32 over names, dtypes, shapes, and bytes of every array — the
+    whole meaning of the entry, so a flipped bit anywhere is a miss."""
+    crc = 0
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        for part in (name, str(a.dtype), str(a.shape)):
+            crc = zlib.crc32(part.encode(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
 
 
 def default_cache_dir() -> Optional[str]:
@@ -51,6 +74,11 @@ class CacheDir:
         self.path = path
         if path:
             os.makedirs(path, exist_ok=True)
+            # debris from writers killed mid-store; age-gated because
+            # the cache dir is SHARED — a fresh .tmp may belong to a
+            # live concurrent run
+            atomic.sweep_tmp(path,
+                             max_age_s=atomic.SHARED_TMP_MAX_AGE_S)
         self.hits = 0
         self.misses = 0
 
@@ -79,26 +107,39 @@ class CacheDir:
         try:
             with np.load(entry) as z:
                 out = {name: z[name] for name in z.files}
-            self.hits += 1
-            self._count("cache.hits",
-                        "Sketch/profile cache entries reused from disk")
-            return out
         except FileNotFoundError:
             self.misses += 1
             self._count("cache.misses",
                         "Sketch/profile cache lookups that recomputed")
             return None
-        except Exception as exc:  # corrupt entry: drop and recompute
-            logger.warning("Dropping unreadable cache entry %s (%s)",
-                           entry, exc)
-            try:
-                os.unlink(entry)
-            except OSError:
-                pass
-            self.misses += 1
-            self._count("cache.misses",
-                        "Sketch/profile cache lookups that recomputed")
-            return None
+        except Exception as exc:  # truncated/unreadable: miss-and-repair
+            return self._repair(entry, f"unreadable ({exc})")
+        check = out.pop(_CHECK_KEY, None)
+        if check is not None and int(check[0]) != _content_crc(out):
+            # a flipped bit would otherwise become a silently-wrong
+            # sketch — the one failure mode a cache must never have
+            return self._repair(entry, "content checksum mismatch")
+        self.hits += 1
+        self._count("cache.hits",
+                    "Sketch/profile cache entries reused from disk")
+        return out
+
+    def _repair(self, entry: str,
+                why: str) -> None:
+        """Corrupt entry: drop the file and report a miss — the caller
+        recomputes and store() restores a good entry."""
+        logger.warning("Dropping corrupt cache entry %s (%s)", entry,
+                       why)
+        try:
+            os.unlink(entry)
+        except OSError:
+            pass
+        self.misses += 1
+        self._count("cache.misses",
+                    "Sketch/profile cache lookups that recomputed")
+        self._count("cache.repaired",
+                    "Corrupt cache entries dropped for recompute")
+        return None
 
     @staticmethod
     def _count(name: str, help: str) -> None:
@@ -114,17 +155,14 @@ class CacheDir:
         if not self.enabled:
             return
         entry = self._entry_path(genome_path, kind, params)
-        fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, **arrays)
-            os.replace(tmp, entry)
-        except Exception:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        if _CHECK_KEY in arrays:
+            raise ValueError(f"{_CHECK_KEY!r} is reserved for the "
+                             "cache's content checksum")
+        payload = dict(arrays)
+        payload[_CHECK_KEY] = np.array([_content_crc(arrays)],
+                                       dtype=np.uint64)
+        atomic.write_npz(entry, payload,
+                         site=f"io.atomic.write[cache.{kind}]")
 
     def stats(self) -> str:
         return f"{self.hits} hits / {self.misses} misses"

@@ -114,38 +114,30 @@ def key_of(entry: Dict[str, Any]) -> str:
 
 
 def append(path: str, entry: Dict[str, Any]) -> None:
-    """Append one complete JSON line (creating parent dirs)."""
-    parent = os.path.dirname(os.path.abspath(path))
-    os.makedirs(parent, exist_ok=True)
-    line = json.dumps(entry, sort_keys=True)
-    if "\n" in line:  # defensive: a newline would tear the format
-        raise ValueError("ledger entries must serialize to one line")
-    with open(path, "a") as fh:
-        fh.write(line + "\n")
+    """Durably append one checksum-framed line (creating parent dirs).
+
+    io/atomic.py owns the write discipline (O_APPEND single write +
+    fsync + crc framing); this module keeps only the schema. atomic is
+    as jax-free as this module, so the import discipline holds."""
+    from galah_tpu.io import atomic
+
+    atomic.append_jsonl(path, entry, site="io.atomic.append[ledger]")
 
 
 def read(path: str) -> Tuple[List[Dict[str, Any]], int]:
     """All parseable entries in file order, plus the count of skipped
-    (torn/corrupt) lines. A missing file is an empty ledger."""
-    if not os.path.exists(path):
-        return [], 0
+    (torn/corrupt) lines. A missing file is an empty ledger. Framed
+    (crc-checked) and legacy plain lines both parse."""
+    from galah_tpu.io import atomic
+
+    records, skipped = atomic.read_jsonl(path)
     entries: List[Dict[str, Any]] = []
-    skipped = 0
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except ValueError:
-                skipped += 1
-                continue
-            if isinstance(obj, dict) and isinstance(
-                    obj.get("metrics"), dict):
-                entries.append(obj)
-            else:
-                skipped += 1
+    for obj in records:
+        if isinstance(obj, dict) and isinstance(
+                obj.get("metrics"), dict):
+            entries.append(obj)
+        else:
+            skipped += 1
     return entries, skipped
 
 

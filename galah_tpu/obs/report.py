@@ -169,6 +169,12 @@ def assemble(subcommand: str,
         "events": obs_events.snapshot(),
     }
     try:
+        from galah_tpu.resilience import interrupt
+
+        report["preemption"] = interrupt.snapshot()
+    except Exception:  # additive section; never lose a report
+        logger.debug("preemption snapshot failed", exc_info=True)
+    try:
         from galah_tpu.obs import profile as obs_profile
 
         report["device_costs"] = obs_profile.snapshot()
@@ -180,13 +186,10 @@ def assemble(subcommand: str,
 
 
 def write(path: str, report: dict) -> None:
-    parent = os.path.dirname(os.path.abspath(path))
-    os.makedirs(parent, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(report, fh, indent=1, sort_keys=True)
-        fh.write("\n")
-    os.replace(tmp, path)
+    from galah_tpu.io import atomic
+
+    atomic.write_json(path, report, indent=1,
+                      site="io.atomic.write[report]")
     logger.info("Wrote run report to %s", path)
 
 

@@ -16,6 +16,26 @@ Fault classes (the failure signatures observed on hardware):
   * ``garbage``     — let the dispatch run, then truncate its result so
                       shape validation must reject it
 
+Filesystem fault classes, consulted by io/atomic.py at ``io.atomic.*``
+sites (the durable-write layer is where crash consistency must be
+proven, so that is where the faults live):
+
+  * ``enospc``      — OSError(ENOSPC) before the write starts
+  * ``eio``         — OSError(EIO) before the write starts
+  * ``torn-write``  — half the payload reaches disk, then the write
+                      fails (the readers' checksum/recovery paths must
+                      treat the debris as absent, never as data)
+  * ``slow-io``     — sleep `hang_seconds` before the write
+
+And the chaos primitive, firing at ANY registered site (dispatch or
+filesystem):
+
+  * ``kill``        — ``os._exit(KILL_EXIT_CODE)``: the process dies
+                      mid-operation with no cleanup, unwinding, or
+                      flushing — a preemption/SIGKILL stand-in the
+                      chaos harness (scripts/chaos_run.py) uses to
+                      prove resume-to-identical-clusters
+
 Configuration is programmatic (`install`) or env-driven via GALAH_FI:
 
     GALAH_FI="site=dispatch.ani;kind=raise;prob=0.3;seed=7;max=2"
@@ -29,6 +49,7 @@ per-call coin flips reproducible.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import logging
 import os
 import random
@@ -43,7 +64,21 @@ from galah_tpu.resilience.policy import (
 
 logger = logging.getLogger(__name__)
 
-FAULT_KINDS = ("raise", "device-lost", "hang", "garbage")
+#: Kinds that fire inside io/atomic.py (plus "kill", which fires
+#: everywhere).
+FS_FAULT_KINDS = ("enospc", "eio", "torn-write", "slow-io")
+
+FAULT_KINDS = (("raise", "device-lost", "hang", "garbage", "kill")
+               + FS_FAULT_KINDS)
+
+#: Exit status used by the "kill" kind — the classic SIGKILL status, so
+#: harnesses treat an injected kill exactly like a real preemption.
+KILL_EXIT_CODE = 137
+
+#: Kinds eligible at dispatch sites (before_dispatch).
+_DISPATCH_KINDS = frozenset({"raise", "device-lost", "hang", "kill"})
+#: Kinds eligible at filesystem sites (io/atomic.py).
+_FS_KINDS = frozenset(FS_FAULT_KINDS) | {"kill"}
 
 # Concurrency contract, machine-checked by `galah-tpu lint` (GL8xx):
 # fault draws arrive from prefetch worker threads; the fired counts
@@ -137,11 +172,17 @@ class FaultInjector:
         with self._lock:
             return sum(self._fired)
 
-    def _draw(self, site: str) -> Optional[FaultSpec]:
+    def _draw(self, site: str, kinds) -> Optional[FaultSpec]:
+        """One seeded coin flip per matching spec of an eligible kind.
+
+        Specs of other kinds are skipped WITHOUT advancing their RNG,
+        so a spec's fault schedule depends only on the sequence of
+        sites where it was eligible — the property the chaos harness's
+        seed sweep relies on."""
         with self._lock:
             for n, spec in enumerate(self._specs):
-                if spec.kind == "garbage":
-                    continue  # garbage fires in corrupt(), not here
+                if spec.kind not in kinds:
+                    continue
                 if not site.startswith(spec.site):
                     continue
                 if (spec.max_faults is not None
@@ -152,12 +193,23 @@ class FaultInjector:
                     return spec
         return None
 
+    @staticmethod
+    def _kill(site: str) -> None:
+        logger.error("fault injector: KILL at %s (exit %d)", site,
+                     KILL_EXIT_CODE)
+        # os._exit on purpose: no atexit, no finally blocks, no stream
+        # flushing — the whole point is to die the way a preemption
+        # does, mid-operation.
+        os._exit(KILL_EXIT_CODE)
+
     def before_dispatch(self, site: str) -> None:
-        """Called before the real dispatch: may raise or stall."""
-        spec = self._draw(site)
+        """Called before the real dispatch: may raise, stall, or die."""
+        spec = self._draw(site, _DISPATCH_KINDS)
         if spec is None:
             return
         logger.warning("fault injector: %s at %s", spec.kind, site)
+        if spec.kind == "kill":
+            self._kill(site)
         if spec.kind == "raise":
             raise TransientDispatchError(
                 f"injected transient fault at {site}")
@@ -165,6 +217,29 @@ class FaultInjector:
             raise DeviceLostError(f"injected device loss at {site}")
         if spec.kind == "hang":
             self._sleep(spec.hang_seconds)
+
+    def filesystem(self, site: str) -> Optional[str]:
+        """Called by io/atomic.py before a durable write: may raise
+        OSError, stall, die, or ask the writer to tear its own write.
+
+        Returns "torn-write" when the writer should half-write and
+        fail (only the writer knows its record layout), else None.
+        """
+        spec = self._draw(site, _FS_KINDS)
+        if spec is None:
+            return None
+        logger.warning("fault injector: %s at %s", spec.kind, site)
+        if spec.kind == "kill":
+            self._kill(site)
+        if spec.kind == "enospc":
+            raise OSError(errno.ENOSPC,
+                          f"injected ENOSPC at {site}")
+        if spec.kind == "eio":
+            raise OSError(errno.EIO, f"injected EIO at {site}")
+        if spec.kind == "slow-io":
+            self._sleep(spec.hang_seconds)
+            return None
+        return "torn-write"
 
     def corrupt(self, site: str, result):
         """Called on the real dispatch's result: may mangle it.

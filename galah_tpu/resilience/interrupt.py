@@ -1,0 +1,153 @@
+"""Cooperative preemption: stop at safe boundaries, resume later.
+
+Preemptible TPU slices get SIGTERM with a short grace window; an
+interactive operator sends SIGINT. Before this module the process just
+died wherever it happened to be — mid-checkpoint-append, mid-report —
+and the only crash-consistency story was the durable-write layer's
+torn-tail recovery. This module adds the cooperative half: handlers
+that REQUEST a stop, and checkpoints/engine loops that honor it at the
+next safe boundary (a round edge or a completed checkpoint flush),
+so the common preemption leaves a clean checkpoint instead of relying
+on recovery at all.
+
+Protocol:
+
+  * ``install()`` registers SIGTERM/SIGINT handlers. The first signal
+    only sets a flag and records which signal arrived; a second signal
+    means "now", and the process hard-exits with ``EXIT_PREEMPTED``
+    immediately (the durable-write layer makes that survivable too).
+  * long-running loops call ``check("boundary-name")`` right AFTER
+    their state reaches disk; it raises ``PreemptionRequested`` when a
+    stop is pending. The CLI catches it, drains obs/ledger/checkpoint
+    writers via the normal finalize path, emits a structured
+    ``preempted`` event, and exits with ``EXIT_PREEMPTED`` (75,
+    EX_TEMPFAIL: "transient, retry me") so wrappers can distinguish
+    preemption from failure.
+  * ``note_resume()`` records that this run continued an interrupted
+    one; ``snapshot()`` feeds the run report's ``preemption`` section.
+
+CPython delivers signals only on the main thread, and worker threads
+only ever read the stop flag through a ``threading.Event`` — so the
+module needs no locks of its own.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: EX_TEMPFAIL — the documented "preempted, safe to retry" exit code.
+EXIT_PREEMPTED = 75
+
+_HANDLED_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class PreemptionRequested(Exception):
+    """Raised at a safe boundary after a stop was requested.
+
+    Carries which boundary honored the request — the run report and
+    the preempted event record it so a resume (and the chaos harness)
+    can see exactly where the run stopped."""
+
+    def __init__(self, boundary: str, signame: str) -> None:
+        super().__init__(
+            f"preemption requested ({signame}), stopping at safe "
+            f"boundary {boundary!r}")
+        self.boundary = boundary
+        self.signame = signame
+
+
+_STOP = threading.Event()
+_SIGNALS: List[str] = []      # arrival order, main thread only
+_BOUNDARY: Optional[str] = None
+_RESUMED_FROM: Optional[str] = None
+_PRIOR_INTERRUPTIONS = 0
+_PREV_HANDLERS: Dict[int, Any] = {}
+
+
+def _handler(signum, frame) -> None:
+    signame = signal.Signals(signum).name
+    if _STOP.is_set():
+        # Second signal: the operator/scheduler is done waiting. Die
+        # now with the preemption code; durable artifacts are already
+        # crash-consistent by construction.
+        logger.error("second signal %s: exiting immediately (%d)",
+                     signame, EXIT_PREEMPTED)
+        os._exit(EXIT_PREEMPTED)
+    _SIGNALS.append(signame)
+    _STOP.set()
+    logger.warning(
+        "%s received: will stop at the next safe boundary "
+        "(send again to exit immediately)", signame)
+
+
+def install() -> None:
+    """Register the cooperative handlers (idempotent; main thread)."""
+    for sig in _HANDLED_SIGNALS:
+        prev = signal.signal(sig, _handler)
+        if sig not in _PREV_HANDLERS:
+            _PREV_HANDLERS[sig] = prev
+
+
+def uninstall() -> None:
+    """Restore whatever handlers install() displaced."""
+    for sig, prev in _PREV_HANDLERS.items():
+        signal.signal(sig, prev)
+    _PREV_HANDLERS.clear()
+
+
+def reset() -> None:
+    """Clear all interruption state (tests; between CLI invocations)."""
+    global _BOUNDARY, _RESUMED_FROM, _PRIOR_INTERRUPTIONS
+    _STOP.clear()
+    _SIGNALS.clear()
+    _BOUNDARY = None
+    _RESUMED_FROM = None
+    _PRIOR_INTERRUPTIONS = 0
+
+
+def request_stop(signame: str = "REQUESTED") -> None:
+    """Programmatic stop request (tests; chaos harness)."""
+    _SIGNALS.append(signame)
+    _STOP.set()
+
+
+def stop_requested() -> bool:
+    return _STOP.is_set()
+
+
+def check(boundary: str) -> None:
+    """Honor a pending stop request: call right after the state that
+    makes `boundary` safe has reached disk."""
+    global _BOUNDARY
+    if not _STOP.is_set():
+        return
+    if _BOUNDARY is None:
+        _BOUNDARY = boundary
+    signame = _SIGNALS[-1] if _SIGNALS else "REQUESTED"
+    raise PreemptionRequested(boundary, signame)
+
+
+def note_resume(resumed_from: str, prior_interruptions: int) -> None:
+    """Record that this run continues an interrupted one."""
+    global _RESUMED_FROM, _PRIOR_INTERRUPTIONS
+    _RESUMED_FROM = resumed_from
+    _PRIOR_INTERRUPTIONS = prior_interruptions
+    logger.info("resuming from %s (%d prior interruption(s))",
+                resumed_from, prior_interruptions)
+
+
+def snapshot() -> Dict[str, Any]:
+    """The run report's ``preemption`` section."""
+    return {
+        "stop_requested": _STOP.is_set(),
+        "signals": list(_SIGNALS),
+        "boundary": _BOUNDARY,
+        "resumed_from": _RESUMED_FROM,
+        "prior_interruptions": _PRIOR_INTERRUPTIONS,
+    }

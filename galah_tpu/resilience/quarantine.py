@@ -67,17 +67,14 @@ class QuarantineManifest:
 
     def write(self, directory: str) -> str:
         """Write the manifest into `directory`; returns the file path."""
-        os.makedirs(directory or ".", exist_ok=True)
+        from galah_tpu.io import atomic
+
         out = os.path.join(directory or ".", MANIFEST_NAME)
-        tmp = out + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({
-                "version": 1,
-                "quarantined": [dataclasses.asdict(r)
-                                for r in self._records],
-            }, f, indent=2, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, out)
+        atomic.write_json(out, {
+            "version": 1,
+            "quarantined": [dataclasses.asdict(r)
+                            for r in self._records],
+        }, indent=2, site="io.atomic.write[quarantine]")
         logger.warning("Wrote quarantine manifest (%d genomes) to %s",
                        len(self._records), out)
         return out

@@ -1,0 +1,370 @@
+#!/usr/bin/env python
+"""Kill-anywhere chaos harness: prove preemption safety by killing runs.
+
+Loop (seeded, deterministic given --seed):
+
+  1. build a tiny synthetic-family FASTA workload and compute the
+     uninterrupted reference clustering once;
+  2. each iteration, launch the same clustering as a subprocess with a
+     checkpoint dir and interrupt it a different way — SIGTERM at a
+     random delay (the cooperative path: stop at a safe boundary, exit
+     75), a GALAH_FI ``kill`` fault (os._exit mid-operation at a
+     random dispatch or durable-write site — the SIGKILL/preemption
+     stand-in), or a GALAH_FI filesystem fault (enospc / eio /
+     torn-write inside io/atomic.py);
+  3. resume with ``--resume`` (faults cleared) until the run completes;
+  4. assert: the final cluster output is byte-identical to the
+     uninterrupted reference, every artifact in the checkpoint and
+     cache dirs is readable through the recovery-aware readers with no
+     ``.tmp`` debris left in the (single-owner) checkpoint dir, and
+     the final run_report.json records the interruption/resume chain.
+
+Any violation prints the evidence and exits 1. The acceptance gate is
+25 consecutive passing iterations (``--iterations 25``); the bounded
+CI smoke (tests/test_chaos.py, ``pytest -m chaos``) drives the same
+functions at ~10 iterations.
+
+Usage:
+    python scripts/chaos_run.py --iterations 25 [--seed 0] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from galah_tpu.io import atomic  # noqa: E402
+from galah_tpu.resilience.faults import KILL_EXIT_CODE  # noqa: E402
+from galah_tpu.resilience.interrupt import EXIT_PREEMPTED  # noqa: E402
+
+#: The interruption modes one iteration draws from (round-robin with a
+#: seeded shuffle, so 25 iterations cover every mode several times).
+MODES = ("sigterm", "kill", "enospc", "eio", "torn-write")
+
+RUN_TIMEOUT_S = 600
+
+
+# ---------------------------------------------------------------------------
+# Workload
+# ---------------------------------------------------------------------------
+
+
+def make_workload(root: str, seed: int, families: int = 2,
+                  members: int = 3, length: int = 20_000) -> List[str]:
+    """Synthetic genome families (test_synthetic_families.py recipe):
+    `families` random bases, `members` genomes each at ~0.5%
+    within-family divergence — small enough for seconds-scale CPU
+    runs, structured enough that the clustering is non-trivial."""
+    import numpy as np
+
+    bases = np.array(list("ACGT"))
+    rng = np.random.default_rng(seed)
+    paths = []
+    for fam in range(families):
+        base = rng.integers(0, 4, size=length)
+        for member in range(members):
+            codes = base.copy()
+            if member:
+                sites = rng.random(length) < 0.005
+                codes[sites] = (codes[sites] + rng.integers(
+                    1, 4, size=int(sites.sum()))) % 4
+            p = os.path.join(root, f"fam{fam}_m{member}.fna")
+            seq = "".join(bases[codes])
+            with open(p, "w") as f:
+                f.write(">contig1\n")
+                for i in range(0, len(seq), 70):
+                    f.write(seq[i:i + 70] + "\n")
+            paths.append(p)
+    return paths
+
+
+def cluster_argv(genomes: List[str], out_tsv: str, ckpt: str,
+                 report: str, resume: bool) -> List[str]:
+    argv = [sys.executable, "-m", "galah_tpu.cli", "cluster",
+            "--platform", "cpu",
+            "--genome-fasta-files", *genomes,
+            "--precluster-method", "skani",
+            "--cluster-method", "skani",
+            "--output-cluster-definition", out_tsv,
+            "--checkpoint-dir", ckpt,
+            "--run-report", report]
+    if resume:
+        argv.append("--resume")
+    return argv
+
+
+def launch(argv: List[str], extra_env: Optional[Dict[str, str]] = None
+           ) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.pop("GALAH_FI", None)  # each run decides its own faults
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env or {})
+    return subprocess.Popen(argv, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+
+
+# ---------------------------------------------------------------------------
+# Artifact audit
+# ---------------------------------------------------------------------------
+
+
+def scan_artifacts(ckpt_dir: str) -> List[str]:
+    """Corruption findings in a checkpoint dir AFTER a completed run
+    ([] == clean). Readable-with-recovery is the contract: torn lines
+    rejected by their checksum are expected debris of a kill, but
+    anything the recovery-aware readers cannot read, and any ``.tmp``
+    left in the single-owner checkpoint dir after a successful run
+    (its open sweeps), is a violation."""
+    problems: List[str] = []
+    if not os.path.isdir(ckpt_dir):
+        return problems
+    for name in sorted(os.listdir(ckpt_dir)):
+        p = os.path.join(ckpt_dir, name)
+        if name.endswith(".tmp"):
+            problems.append(f"leftover tmp debris: {p}")
+        elif name.endswith(".jsonl"):
+            try:
+                atomic.read_jsonl(p)
+            except Exception as exc:
+                problems.append(f"unreadable jsonl {p}: {exc}")
+        elif name.endswith(".json"):
+            try:
+                with open(p) as f:
+                    json.load(f)
+            except Exception as exc:
+                problems.append(f"unparseable json {p}: {exc}")
+        elif name.endswith(".npz"):
+            try:
+                import numpy as np
+
+                with np.load(p) as z:
+                    for member in z.files:
+                        z[member]
+            except Exception as exc:
+                problems.append(f"unloadable npz {p}: {exc}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# One iteration
+# ---------------------------------------------------------------------------
+
+
+def fault_env(mode: str, seed: int) -> Optional[Dict[str, str]]:
+    """The GALAH_FI spec for an interruption mode (None for sigterm).
+
+    ``kill`` uses a low per-site probability over ALL sites so the
+    seeded coin picks a different dispatch or durable-write operation
+    each iteration; the fs faults target io/atomic.py and fire once."""
+    if mode == "sigterm":
+        return None
+    if mode == "kill":
+        return {"GALAH_FI":
+                f"site=;kind=kill;prob=0.15;seed={seed};max=1"}
+    return {"GALAH_FI": f"site=io.atomic;kind={mode};prob=0.5;"
+                        f"seed={seed};max=1"}
+
+
+def run_one(genomes: List[str], work: str, mode: str, seed: int,
+            log: List[str]) -> Tuple[bool, str]:
+    """One kill/resume iteration; returns (ok, detail)."""
+    rng = random.Random(f"chaos:{seed}:{mode}")
+    ckpt = os.path.join(work, "ckpt")
+    out_tsv = os.path.join(work, "clusters.tsv")
+    report = os.path.join(work, "report.json")
+
+    # -- interrupted run ------------------------------------------------
+    proc = launch(cluster_argv(genomes, out_tsv, ckpt, report,
+                               resume=False), fault_env(mode, seed))
+    if mode == "sigterm":
+        # the workload runs ~2-3 s end to end (measured on the CPU
+        # backend); this window lands the signal mid-run most of the
+        # time while still exercising the landed-after-exit edge
+        time.sleep(rng.uniform(0.4, 2.2))
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+    try:
+        stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return False, f"{mode}: interrupted run hung"
+    rc = proc.returncode
+    log.append(f"    interrupted run exited {rc}")
+    interrupted = rc != 0
+    # SIGTERM can land before the handlers install (default handler:
+    # -15) or after the run finished (0): all are legitimate outcomes
+    # of killing at an arbitrary instant.
+    acceptable = {0, 1, EXIT_PREEMPTED, KILL_EXIT_CODE, -15,
+                  -signal.SIGKILL}
+    if rc not in acceptable:
+        return False, (f"{mode}: unexpected exit {rc}\n"
+                       + stdout.decode(errors="replace")[-2000:])
+
+    # -- resume until complete (faults cleared) -------------------------
+    for attempt in range(3):
+        if not interrupted:
+            break
+        can_resume = os.path.exists(
+            os.path.join(ckpt, "fingerprint.json"))
+        proc = launch(cluster_argv(genomes, out_tsv, ckpt, report,
+                                   resume=can_resume))
+        try:
+            stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            return False, f"{mode}: resume run hung"
+        log.append(f"    resume attempt {attempt} exited "
+                   f"{proc.returncode} (resume={can_resume})")
+        if proc.returncode == 0:
+            break
+        if attempt == 2:
+            return False, (f"{mode}: resume never completed "
+                           f"(last exit {proc.returncode})\n"
+                           + stdout.decode(errors="replace")[-2000:])
+
+    if not os.path.exists(out_tsv):
+        return False, f"{mode}: completed run left no cluster output"
+    return True, stdout.decode(errors="replace")
+
+
+def check_report(report_path: str, ckpt: str, was_preempted: bool
+                 ) -> Optional[str]:
+    """The final run report must record the resume chain."""
+    try:
+        with open(report_path) as f:
+            rep = json.load(f)
+    except Exception as exc:
+        return f"run report unreadable: {exc}"
+    pre = rep.get("preemption")
+    if not isinstance(pre, dict):
+        return "run report has no preemption section"
+    if pre.get("resumed_from") != ckpt:
+        return (f"resumed_from={pre.get('resumed_from')!r}, "
+                f"expected {ckpt!r}")
+    if was_preempted and pre.get("prior_interruptions", 0) < 1:
+        return ("cooperative preemption left no interruption record "
+                f"(prior_interruptions={pre.get('prior_interruptions')})")
+    return None
+
+
+def run_iteration(genomes: List[str], reference: bytes, workdir: str,
+                  mode: str, seed: int) -> Tuple[bool, str]:
+    work = os.path.join(workdir, f"iter_{seed}_{mode}")
+    os.makedirs(work, exist_ok=True)
+    log: List[str] = []
+    ok, detail = run_one(genomes, work, mode, seed, log)
+    if not ok:
+        return False, "\n".join(log + [detail])
+    ckpt = os.path.join(work, "ckpt")
+    with open(os.path.join(work, "clusters.tsv"), "rb") as f:
+        out = f.read()
+    if out != reference:
+        return False, "\n".join(log + [
+            f"{mode}: resumed clusters differ from the uninterrupted "
+            f"reference ({len(out)} vs {len(reference)} bytes)"])
+    problems = scan_artifacts(ckpt)
+    if problems:
+        return False, "\n".join(log + [f"{mode}: corrupt artifacts:"]
+                                + problems)
+    was_preempted = "exited 75" in "\n".join(log)
+    # the chain is only recorded when the completing run actually
+    # resumed a durable checkpoint; a kill BEFORE the fingerprint ever
+    # reached disk legitimately starts over with no chain to record
+    resumed = any("resume=True" in line for line in log)
+    if resumed:
+        err = check_report(os.path.join(work, "report.json"), ckpt,
+                           was_preempted)
+        if err:
+            return False, "\n".join(log + [f"{mode}: {err}"])
+    return True, "\n".join(log)
+
+
+# ---------------------------------------------------------------------------
+# Harness
+# ---------------------------------------------------------------------------
+
+
+def run_harness(iterations: int, seed: int, workdir: str,
+                verbose: bool = True) -> int:
+    """Full chaos loop; returns the number of FAILED iterations."""
+    gdir = os.path.join(workdir, "genomes")
+    os.makedirs(gdir, exist_ok=True)
+    genomes = make_workload(gdir, seed)
+
+    # uninterrupted reference
+    ref_work = os.path.join(workdir, "reference")
+    os.makedirs(ref_work, exist_ok=True)
+    ref_tsv = os.path.join(ref_work, "clusters.tsv")
+    proc = launch(cluster_argv(
+        genomes, ref_tsv, os.path.join(ref_work, "ckpt"),
+        os.path.join(ref_work, "report.json"), resume=False))
+    stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+    if proc.returncode != 0:
+        print("FATAL: reference run failed:\n"
+              + stdout.decode(errors="replace")[-3000:])
+        return iterations or 1
+    with open(ref_tsv, "rb") as f:
+        reference = f.read()
+    if verbose:
+        nlines = reference.count(b"\n")
+        print(f"reference clustering: {len(reference)} bytes, "
+              f"{nlines} lines")
+
+    rng = random.Random(seed)
+    schedule = [MODES[i % len(MODES)] for i in range(iterations)]
+    rng.shuffle(schedule)
+    failures = 0
+    for i, mode in enumerate(schedule):
+        ok, detail = run_iteration(genomes, reference, workdir, mode,
+                                   seed * 1000 + i)
+        status = "PASS" if ok else "FAIL"
+        if verbose or not ok:
+            print(f"[{i + 1:2d}/{iterations}] {mode:<10s} {status}")
+            if verbose or not ok:
+                for line in detail.splitlines():
+                    if not ok or line.strip().startswith(
+                            ("interrupted", "resume")):
+                        print(f"      {line.strip()}")
+        failures += 0 if ok else 1
+    print(f"chaos: {iterations - failures}/{iterations} iterations "
+          f"passed")
+    return failures
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--iterations", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir for inspection")
+    args = ap.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="galah_chaos_")
+    print(f"chaos scratch: {workdir}")
+    try:
+        failures = run_harness(args.iterations, args.seed, workdir)
+    finally:
+        if not args.keep and not args.workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,19 +48,68 @@ for attempt in $(seq 1 60); do
 done
 
 mkdir -p "$ART"
+
+# PREEMPTION: a SIGTERM/SIGINT to this session (host eviction, ^C,
+# watcher teardown) forwards to the running stage so an in-flight
+# cluster run stops at a safe checkpoint boundary (exit 75, resumable
+# with --resume) instead of being orphaned mid-write. The session then
+# writes a partial summary naming what completed before exiting 75
+# itself — an interrupted capture dir is still a readable artifact.
+STAGE_PID=
+CURRENT_STAGE=
+COMPLETED_STAGES=
+INTERRUPTED=
+on_signal() {
+  INTERRUPTED=$1
+  echo "=== $1 received $(date -u) — forwarding to stage" \
+       "'${CURRENT_STAGE:-none}' ===" >> "$LOG"
+  if [ -n "$STAGE_PID" ] && kill -0 "$STAGE_PID" 2>/dev/null; then
+    # `timeout` relays the signal to its child's process group, so
+    # every tunnel-using descendant (pytest, bench, chaos subprocesses)
+    # sees it and can stop cooperatively
+    kill -TERM "$STAGE_PID" 2>/dev/null
+  fi
+}
+trap 'on_signal SIGTERM' TERM
+trap 'on_signal SIGINT' INT
+partial_summary() {
+  { echo "=== PARTIAL SESSION (interrupted by $INTERRUPTED) $(date -u) ==="
+    echo "completed stages:${COMPLETED_STAGES:- none}"
+    echo "interrupted stage: ${CURRENT_STAGE:-none}"
+    echo "resume: rerun this script; checkpointed stages continue"
+  } | tee -a "$LOG" > "$ART/partial_summary.txt"
+}
+
 run_stage() {  # run_stage <name> <timeout> <cmd...>
   local name=$1 tmo=$2; shift 2
+  CURRENT_STAGE=$name
   echo "--- $name $(date -u) ---" >> "$LOG"
   # Every stage gets a run-report sink (galah_tpu/obs); obs-aware
   # stages (bench, cluster-driving scripts) archive their telemetry
   # next to their capture so sessions are diffable with
   # `galah-tpu report --diff`.
   local report="$ART/${name}_report.json"
-  { echo "=== $name $(date -u) ==="
-    timeout -k 10 "$tmo" env GALAH_OBS_REPORT="$report" "$@" 2>&1
-    echo "--- exit $? $(date -u) ---"
-  } > "$ART/$name.txt"
+  echo "=== $name $(date -u) ===" > "$ART/$name.txt"
+  # Background + `wait` (not foreground) so the TERM/INT traps can run
+  # while the stage is in flight and forward the signal to it.
+  timeout -k 10 "$tmo" env GALAH_OBS_REPORT="$report" "$@" \
+    >> "$ART/$name.txt" 2>&1 &
+  STAGE_PID=$!
+  wait "$STAGE_PID"
+  local rc=$?
+  if [ -n "$INTERRUPTED" ]; then
+    # a trap interrupts the first `wait`; this one collects the
+    # stage's real (cooperative) exit before we summarize
+    wait "$STAGE_PID" 2>/dev/null
+    echo "--- interrupted ($INTERRUPTED) $(date -u) ---" >> "$ART/$name.txt"
+    cat "$ART/$name.txt" >> "$LOG"
+    partial_summary
+    exit 75
+  fi
+  STAGE_PID=
+  echo "--- exit $rc $(date -u) ---" >> "$ART/$name.txt"
   cat "$ART/$name.txt" >> "$LOG"
+  COMPLETED_STAGES="$COMPLETED_STAGES $name"
   # Soft failure: a missing report degrades observability, not the
   # session — warn and keep going (a hard exit here would throw away
   # the remaining hardware stages over telemetry).
@@ -79,6 +128,13 @@ BENCH_TIMEOUT=3000
 # registry, shape snapshots — seconds on the host VM, and a failure
 # here means the expensive hardware stages would exercise broken code.
 run_stage lint 300 python -u -m galah_tpu.analysis --json
+# Kill-anywhere chaos smoke on the host CPU (no tunnel use): seeded
+# interrupted-then-resumed cluster runs must produce byte-identical
+# results with zero corrupt artifacts (docs/resilience.md). Runs early
+# so a durability regression is caught before the long TPU stages
+# depend on checkpoint/resume behaving.
+run_stage chaos_smoke 900 env JAX_PLATFORMS=cpu \
+  python -u scripts/chaos_run.py --iterations 10 --seed 1
 run_stage test_tpu_hw 2400 env GALAH_RUN_SLOW=1 \
   python -u -m pytest tests/test_tpu_hw.py -q
 run_stage amortized 1800 python -u scripts/bench_amortized.py

@@ -44,6 +44,13 @@ def pytest_configure(config):
         "resilience layer (retry/demote/quarantine) — fast, CPU-only, "
         "part of the default tier-1 run; select just them with "
         "-m fault_injection")
+    config.addinivalue_line(
+        "markers",
+        "chaos: bounded kill-anywhere chaos smoke (subprocess runs "
+        "interrupted by SIGTERM / GALAH_FI kill / fs faults, then "
+        "resumed and byte-compared) — slow tier; run with -m chaos "
+        "or GALAH_RUN_SLOW=1; the full 25-iteration acceptance pass "
+        "is scripts/chaos_run.py")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,34 @@
+"""Seeded GL806 violations: hand-rolled durable writes that bypass
+io/atomic.py. Loaded by test_analysis.py with its path overridden to a
+DURABLE_MODULES entry; never scanned in place (data dir is excluded)."""
+
+import json
+import os
+import tempfile
+
+
+def store_entry(path, payload):
+    # write-mode open(): the pre-atomic idiom, torn on a mid-write kill
+    with open(path, "w") as f:
+        json.dump(payload, f)
+
+
+def append_line(path, record):
+    # append mode is also a durable write
+    with open(path, mode="a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def tmp_rename(path, data):
+    # the hand-rolled tmp+rename idiom: no fsync, no dir-fsync, and
+    # invisible to the GALAH_FI filesystem faults
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def read_back(path):
+    # read-mode opens are fine: recovery code reads everything
+    with open(path) as f:
+        return f.read()

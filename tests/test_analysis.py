@@ -581,3 +581,48 @@ def test_changed_files_tracks_git_state(tmp_path):
     (tmp_path / "tracked.py").write_text("x = 2\n")
     (tmp_path / "untracked.py").write_text("y = 1\n")
     assert changed_files(root) == {"tracked.py", "untracked.py"}
+
+
+# -- GL806: durable-write discipline (fs_check) -----------------------
+
+
+def test_bad_durable_write_fixture_fires_gl806():
+    from galah_tpu.analysis.fs_check import DURABLE_MODULES, \
+        check_fs_file
+
+    src = load_fixture("bad_durable_write.py", path=DURABLE_MODULES[0])
+    found = check_fs_file(src)
+    gl806 = sorted(f.line for f in found if f.code == "GL806")
+    # open("w"), open(mode="a"), mkstemp(), fdopen("wb"), os.replace()
+    # — the read-mode open in read_back must NOT fire
+    assert gl806 == [12, 18, 25, 26, 28]
+    assert all(f.severity is Severity.WARNING for f in found)
+    assert all("io/atomic.py" in f.message for f in found)
+
+
+def test_gl806_exempts_atomic_and_out_of_scope_files():
+    from galah_tpu.analysis.fs_check import (SANCTIONED, check_fs_file,
+                                             in_scope)
+
+    # the sanctioned writer itself, and anything outside the
+    # durable-artifact modules, may open files however it likes
+    for path in (SANCTIONED, "galah_tpu/cli.py",
+                 "tests/test_atomic.py", "scripts/chaos_run.py"):
+        assert not in_scope(path)
+        assert check_fs_file(load_fixture("bad_durable_write.py",
+                                          path=path)) == []
+
+
+def test_gl806_suppression_applies():
+    from galah_tpu.analysis.fs_check import DURABLE_MODULES, \
+        check_fs_file
+
+    src = load_fixture("bad_durable_write.py", path=DURABLE_MODULES[0])
+    found = check_fs_file(src)
+    core.apply_suppressions(found, {src.path: src}, {})
+    assert all(not f.suppressed for f in found)  # fixture: none carry one
+
+
+def test_repo_durable_modules_all_write_through_atomic():
+    found = [f for f in run_lint(checks=("fs",)) if not f.suppressed]
+    assert not found, [(f.path, f.line, f.message) for f in found]

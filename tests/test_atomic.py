@@ -1,0 +1,292 @@
+"""Durable-write primitive (io/atomic.py) + cooperative interruption
+(resilience/interrupt.py).
+
+The all-or-nothing contract: a writer killed at ANY instant leaves a
+durable artifact absent, fully old, or fully new — never torn. These
+tests pin the framing format, the torn-tail recovery and self-healing,
+the crash-debris sweep, the GALAH_FI filesystem fault kinds that fire
+inside the primitives, and the signal → safe-boundary → exit-75
+interruption protocol. The kill-anywhere end-to-end proof is
+scripts/chaos_run.py / tests/test_chaos.py.
+"""
+
+import json
+import os
+import signal
+import zlib
+
+import numpy as np
+import pytest
+
+from galah_tpu.io import atomic
+from galah_tpu.resilience import faults, interrupt
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector(monkeypatch):
+    monkeypatch.delenv("GALAH_FI", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- whole-file writes ------------------------------------------------
+
+
+def test_write_bytes_roundtrip_and_no_debris(tmp_path):
+    p = str(tmp_path / "a.bin")
+    atomic.write_bytes(p, b"hello")
+    assert open(p, "rb").read() == b"hello"
+    atomic.write_bytes(p, b"replaced")
+    assert open(p, "rb").read() == b"replaced"
+    assert [n for n in os.listdir(tmp_path) if n.endswith(".tmp")] == []
+
+
+def test_write_json_sorted_and_newline_terminated(tmp_path):
+    p = str(tmp_path / "r.json")
+    atomic.write_json(p, {"b": 1, "a": 2})
+    raw = open(p).read()
+    assert raw.endswith("\n")
+    assert json.loads(raw) == {"a": 2, "b": 1}
+    assert raw.index('"a"') < raw.index('"b"')
+
+
+def test_write_npz_roundtrip(tmp_path):
+    p = str(tmp_path / "d.npz")
+    atomic.write_npz(p, {"x": np.arange(4), "y": np.eye(2)})
+    with np.load(p) as z:
+        np.testing.assert_array_equal(z["x"], np.arange(4))
+        np.testing.assert_array_equal(z["y"], np.eye(2))
+
+
+def test_write_creates_parent_dirs(tmp_path):
+    p = str(tmp_path / "deep" / "er" / "f.json")
+    atomic.write_json(p, [1, 2])
+    assert json.load(open(p)) == [1, 2]
+
+
+# -- append framing ---------------------------------------------------
+
+
+def test_frame_line_format_and_crc(tmp_path):
+    line = atomic.frame_line({"k": "v"})
+    assert line.endswith("\n")
+    payload, sep, crc_hex = line.rstrip("\n").rpartition(
+        atomic.FRAME_SEP)
+    assert sep == atomic.FRAME_SEP
+    assert json.loads(payload) == {"k": "v"}
+    assert int(crc_hex, 16) == zlib.crc32(payload.encode()) & 0xFFFFFFFF
+
+
+def test_frame_sep_is_not_a_splitlines_boundary():
+    """Tooling reads these logs line-wise; the separator must not make
+    str.splitlines see two lines per record (as \\x1e would)."""
+    assert len(atomic.frame_line({"a": 1}).splitlines()) == 1
+
+
+def test_append_read_roundtrip_in_order(tmp_path):
+    p = str(tmp_path / "log.jsonl")
+    for i in range(5):
+        atomic.append_jsonl(p, {"i": i})
+    records, bad = atomic.read_jsonl(p)
+    assert bad == 0
+    assert [r["i"] for r in records] == list(range(5))
+
+
+def test_read_jsonl_missing_file_is_empty(tmp_path):
+    assert atomic.read_jsonl(str(tmp_path / "nope.jsonl")) == ([], 0)
+
+
+def test_read_jsonl_rejects_flipped_byte(tmp_path):
+    p = str(tmp_path / "log.jsonl")
+    atomic.append_jsonl(p, {"i": 0})
+    atomic.append_jsonl(p, {"i": 1})
+    raw = bytearray(open(p, "rb").read())
+    raw[2] ^= 0xFF  # corrupt record 0's payload
+    open(p, "wb").write(bytes(raw))
+    records, bad = atomic.read_jsonl(p)
+    assert bad == 1
+    assert [r["i"] for r in records] == [1]
+
+
+def test_read_jsonl_accepts_legacy_unframed_lines(tmp_path):
+    p = str(tmp_path / "old.jsonl")
+    with open(p, "w") as f:
+        f.write('{"legacy": true}\n')
+    atomic.append_jsonl(p, {"legacy": False})
+    records, bad = atomic.read_jsonl(p)
+    assert bad == 0
+    assert [r["legacy"] for r in records] == [True, False]
+
+
+def test_append_heals_torn_tail(tmp_path):
+    """A record appended after a torn tail must itself stay intact:
+    the torn bytes are confined to their own (rejected) line."""
+    p = str(tmp_path / "log.jsonl")
+    atomic.append_jsonl(p, {"i": 0})
+    with open(p, "ab") as f:  # simulate a kill mid-append: no newline
+        f.write(atomic.frame_line({"i": 1}).encode()[:4])
+    atomic.append_jsonl(p, {"i": 2})
+    records, bad = atomic.read_jsonl(p)
+    assert bad == 1
+    assert [r["i"] for r in records] == [0, 2]
+
+
+# -- crash-debris sweep -----------------------------------------------
+
+
+def test_sweep_tmp_single_owner_removes_all(tmp_path):
+    (tmp_path / "x.json.abc123.tmp").write_bytes(b"debris")
+    (tmp_path / "keep.json").write_bytes(b"{}")
+    assert atomic.sweep_tmp(str(tmp_path)) == 1
+    assert (tmp_path / "keep.json").exists()
+    assert not (tmp_path / "x.json.abc123.tmp").exists()
+
+
+def test_sweep_tmp_age_gate_spares_young_files(tmp_path):
+    (tmp_path / "young.tmp").write_bytes(b"live writer")
+    assert atomic.sweep_tmp(str(tmp_path),
+                            max_age_s=atomic.SHARED_TMP_MAX_AGE_S) == 0
+    old = tmp_path / "old.tmp"
+    old.write_bytes(b"stale")
+    os.utime(old, (1, 1))
+    assert atomic.sweep_tmp(str(tmp_path),
+                            max_age_s=atomic.SHARED_TMP_MAX_AGE_S) == 1
+    assert (tmp_path / "young.tmp").exists()
+
+
+def test_sweep_tmp_missing_dir_is_zero(tmp_path):
+    assert atomic.sweep_tmp(str(tmp_path / "absent")) == 0
+
+
+# -- filesystem fault kinds -------------------------------------------
+
+
+@pytest.mark.fault_injection
+def test_enospc_fault_leaves_target_untouched(tmp_path, monkeypatch):
+    p = str(tmp_path / "a.json")
+    atomic.write_json(p, {"v": 1})
+    monkeypatch.setenv(
+        "GALAH_FI", "site=io.atomic;kind=enospc;prob=1;seed=1")
+    faults.reset()
+    with pytest.raises(OSError) as ei:
+        atomic.write_json(p, {"v": 2})
+    assert ei.value.errno == 28  # ENOSPC
+    assert json.load(open(p)) == {"v": 1}  # old content fully intact
+
+
+@pytest.mark.fault_injection
+def test_eio_fault_on_append_keeps_log_readable(tmp_path, monkeypatch):
+    p = str(tmp_path / "log.jsonl")
+    atomic.append_jsonl(p, {"i": 0})
+    monkeypatch.setenv(
+        "GALAH_FI", "site=io.atomic;kind=eio;prob=1;seed=1")
+    faults.reset()
+    with pytest.raises(OSError) as ei:
+        atomic.append_jsonl(p, {"i": 1})
+    assert ei.value.errno == 5  # EIO
+    records, bad = atomic.read_jsonl(p)
+    assert [r["i"] for r in records] == [0] and bad == 0
+
+
+@pytest.mark.fault_injection
+def test_torn_write_fault_leaves_sweepable_debris(tmp_path,
+                                                  monkeypatch):
+    p = str(tmp_path / "a.json")
+    atomic.write_json(p, {"v": 1})
+    monkeypatch.setenv(
+        "GALAH_FI", "site=io.atomic;kind=torn-write;prob=1;seed=1;max=1")
+    faults.reset()
+    with pytest.raises(OSError):
+        atomic.write_json(p, {"v": 2})
+    assert json.load(open(p)) == {"v": 1}
+    debris = [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    assert len(debris) == 1  # the half-written tmp a real kill leaves
+    assert atomic.sweep_tmp(str(tmp_path)) == 1
+    atomic.write_json(p, {"v": 3})  # max=1: injector is spent
+    assert json.load(open(p)) == {"v": 3}
+
+
+@pytest.mark.fault_injection
+def test_torn_append_recovered_by_next_append(tmp_path, monkeypatch):
+    p = str(tmp_path / "log.jsonl")
+    atomic.append_jsonl(p, {"i": 0})
+    monkeypatch.setenv(
+        "GALAH_FI", "site=io.atomic;kind=torn-write;prob=1;seed=1;max=1")
+    faults.reset()
+    with pytest.raises(OSError):
+        atomic.append_jsonl(p, {"i": 1})
+    monkeypatch.delenv("GALAH_FI")
+    faults.reset()
+    atomic.append_jsonl(p, {"i": 2})
+    records, bad = atomic.read_jsonl(p)
+    assert bad == 1  # the torn half-record, rejected by its checksum
+    assert [r["i"] for r in records] == [0, 2]
+
+
+@pytest.mark.fault_injection
+def test_slow_io_fault_succeeds_after_delay(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "GALAH_FI",
+        "site=io.atomic;kind=slow-io;prob=1;seed=1;hang=0.01;max=1")
+    faults.reset()
+    p = str(tmp_path / "a.json")
+    atomic.write_json(p, {"v": 1})  # delayed, not failed
+    assert json.load(open(p)) == {"v": 1}
+
+
+# -- cooperative interruption -----------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _clean_interrupt():
+    interrupt.reset()
+    yield
+    interrupt.uninstall()
+    interrupt.reset()
+
+
+def test_check_passes_when_no_stop_requested():
+    interrupt.check("round-boundary")  # no raise
+    assert not interrupt.stop_requested()
+
+
+def test_request_stop_raises_at_next_boundary():
+    interrupt.request_stop("TEST")
+    with pytest.raises(interrupt.PreemptionRequested) as ei:
+        interrupt.check("greedy-round-saved")
+    assert ei.value.boundary == "greedy-round-saved"
+    assert ei.value.signame == "TEST"
+
+
+def test_sigterm_sets_flag_cooperatively():
+    interrupt.install()
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert interrupt.stop_requested()
+    with pytest.raises(interrupt.PreemptionRequested) as ei:
+        interrupt.check("distances-saved")
+    assert ei.value.signame == "SIGTERM"
+    snap = interrupt.snapshot()
+    assert snap["signals"] == ["SIGTERM"]
+    assert snap["boundary"] == "distances-saved"
+
+
+def test_uninstall_restores_previous_handlers():
+    prev = signal.getsignal(signal.SIGTERM)
+    interrupt.install()
+    assert signal.getsignal(signal.SIGTERM) is not prev
+    interrupt.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_snapshot_records_resume_chain():
+    interrupt.note_resume("/ck/dir", prior_interruptions=2)
+    snap = interrupt.snapshot()
+    assert snap["resumed_from"] == "/ck/dir"
+    assert snap["prior_interruptions"] == 2
+    interrupt.reset()
+    assert interrupt.snapshot()["resumed_from"] is None
+
+
+def test_exit_code_is_ex_tempfail():
+    assert interrupt.EXIT_PREEMPTED == 75

@@ -178,3 +178,105 @@ def test_torn_record_resume_identical_clusters(tmp_path):
     out = cluster(GENOMES, pre, cl, checkpoint=ck2)
     assert out == ref
     assert pre.calls == 0  # distance pass still resumed from disk
+
+
+# -- fingerprint path normalization + --resume strictness -------------
+
+
+def test_fingerprint_insensitive_to_path_spelling(tmp_path):
+    """./a.fna, a.fna, an absolute path, and a symlinked spelling of
+    the same file must fingerprint identically — a resume launched
+    from a different cwd must not discard a valid checkpoint."""
+    import os
+
+    from galah_tpu.cluster.checkpoint import fingerprint_fields
+
+    g = tmp_path / "a.fna"
+    g.write_text(">c\nACGT\n")
+    link = tmp_path / "ln.fna"
+    os.symlink(g, link)
+    old = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        spellings = ["a.fna", "./a.fna", str(g), "ln.fna", str(link)]
+        fields = [fingerprint_fields([s], "p", "c", 0.95, 0.9)
+                  for s in spellings]
+    finally:
+        os.chdir(old)
+    assert all(f == fields[0] for f in fields[1:])
+    assert fields[0]["genomes"] == [str(g)]
+
+
+def test_fingerprint_differs_for_different_files(tmp_path):
+    from galah_tpu.cluster.checkpoint import (fields_digest,
+                                              fingerprint_fields)
+
+    a = fields_digest(fingerprint_fields(["a"], "p", "c", 0.95, 0.9))
+    b = fields_digest(fingerprint_fields(["b"], "p", "c", 0.95, 0.9))
+    assert a != b
+
+
+def test_mismatch_logs_differing_field_names(tmp_path, caplog):
+    """Operators get the CHANGED FIELD by name, not just two sha256s."""
+    import logging
+
+    from galah_tpu.cluster.checkpoint import (fields_digest,
+                                              fingerprint_fields)
+
+    f1 = fingerprint_fields(GENOMES, "fake", "fakecl", 0.95, 0.9)
+    ClusterCheckpoint(str(tmp_path / "ck"), fields_digest(f1),
+                      fields=f1)
+    f2 = fingerprint_fields(GENOMES, "fake", "fakecl", 0.99, 0.9)
+    with caplog.at_level(logging.WARNING):
+        ClusterCheckpoint(str(tmp_path / "ck"), fields_digest(f2),
+                          fields=f2)
+    assert "mismatched fields: ani" in caplog.text
+    assert "checkpoint=0.95" in caplog.text and "run=0.99" in caplog.text
+
+
+def test_require_match_raises_on_mismatch_and_keeps_state(tmp_path):
+    """--resume refuses to silently discard a checkpoint that belongs
+    to a different configuration."""
+    from galah_tpu.cluster.checkpoint import (fields_digest,
+                                              fingerprint_fields)
+
+    f1 = fingerprint_fields(GENOMES, "fake", "fakecl", 0.95, 0.9)
+    ck = ClusterCheckpoint(str(tmp_path / "ck"), fields_digest(f1),
+                           fields=f1)
+    cluster(GENOMES, FakePre(), FakeCl(0.95), checkpoint=ck)
+
+    f2 = fingerprint_fields(GENOMES, "fake", "fakecl", 0.99, 0.9)
+    with pytest.raises(ValueError, match="different run configuration"):
+        ClusterCheckpoint(str(tmp_path / "ck"), fields_digest(f2),
+                          fields=f2, require_match=True)
+    # the mismatching open must NOT have wiped the state
+    assert (tmp_path / "ck" / "clusters.jsonl").exists()
+
+
+def test_require_match_raises_on_empty_dir(tmp_path):
+    from galah_tpu.cluster.checkpoint import (fields_digest,
+                                              fingerprint_fields)
+
+    f = fingerprint_fields(GENOMES, "fake", "fakecl", 0.95, 0.9)
+    with pytest.raises(ValueError, match="no checkpoint fingerprint"):
+        ClusterCheckpoint(str(tmp_path / "ck"), fields_digest(f),
+                          fields=f, require_match=True)
+
+
+def test_interruption_log_roundtrip(tmp_path):
+    from galah_tpu.cluster.checkpoint import (fields_digest,
+                                              fingerprint_fields)
+
+    f = fingerprint_fields(GENOMES, "fake", "fakecl", 0.95, 0.9)
+    ck = ClusterCheckpoint(str(tmp_path / "ck"), fields_digest(f),
+                           fields=f)
+    assert ck.load_interruptions() == []
+    ck.record_interruption({"signal": "SIGTERM",
+                            "boundary": "greedy-round-saved"})
+    ck.record_interruption({"signal": "SIGTERM",
+                            "boundary": "precluster-saved"})
+    ck2 = ClusterCheckpoint(str(tmp_path / "ck"), fields_digest(f),
+                            fields=f)
+    chain = ck2.load_interruptions()
+    assert [c["boundary"] for c in chain] == ["greedy-round-saved",
+                                              "precluster-saved"]

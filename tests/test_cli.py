@@ -464,3 +464,95 @@ def test_platform_flag_bad_value_clean_error(tmp_path):
     assert proc.returncode == 1, (proc.returncode, proc.stderr[-500:])
     assert "Traceback" not in proc.stderr
     assert "--platform cuda" in proc.stderr and "failed to initialize" in proc.stderr
+
+
+# -- preemption / --resume -------------------------------------------
+# These run on generated genomes, not the reference fixtures: the
+# contract under test is the interruption protocol, not clustering.
+
+
+def _tiny_genomes(tmp_path, n=4):
+    import random as _random
+
+    rng = _random.Random(7)
+    paths = []
+    base = [rng.choice("ACGT") for _ in range(5000)]
+    for i in range(n):
+        seq = list(base)
+        for _ in range(i * 10):  # small divergence between genomes
+            pos = rng.randrange(len(seq))
+            seq[pos] = rng.choice("ACGT")
+        p = tmp_path / f"g{i}.fna"
+        p.write_text(">c\n" + "".join(seq) + "\n")
+        paths.append(str(p))
+    return paths
+
+
+def test_resume_requires_checkpoint_dir(tmp_path):
+    out = tmp_path / "c.tsv"
+    rc = _run(["cluster", "--genome-fasta-files",
+               *_tiny_genomes(tmp_path), "--resume",
+               "--output-cluster-definition", str(out)])
+    assert rc == 1
+
+
+def test_resume_refuses_empty_checkpoint_dir(tmp_path):
+    out = tmp_path / "c.tsv"
+    rc = _run(["cluster", "--genome-fasta-files",
+               *_tiny_genomes(tmp_path), "--resume",
+               "--checkpoint-dir", str(tmp_path / "ck"),
+               "--output-cluster-definition", str(out)])
+    assert rc == 1  # no fingerprint to resume from
+
+
+def test_preemption_exits_75_then_resume_completes(tmp_path,
+                                                   monkeypatch):
+    """A stop requested right after install preempts at the first safe
+    boundary (exit 75, no output, interruption recorded); `--resume`
+    then completes with the chain in the run report."""
+    import json
+
+    from galah_tpu.resilience import interrupt
+
+    genomes = _tiny_genomes(tmp_path)
+    out = tmp_path / "c.tsv"
+    ck = tmp_path / "ck"
+    report = tmp_path / "report.json"
+
+    real_install = interrupt.install
+
+    def install_and_stop():
+        real_install()
+        interrupt.request_stop("TEST")
+
+    monkeypatch.setattr(interrupt, "install", install_and_stop)
+    rc = _run(["cluster", "--genome-fasta-files", *genomes,
+               "--checkpoint-dir", str(ck),
+               "--output-cluster-definition", str(out),
+               "--run-report", str(report)])
+    assert rc == interrupt.EXIT_PREEMPTED == 75
+    # preempted before write-outputs: the handle exists (setup_outputs
+    # opens it up front) but no cluster rows were written
+    assert not out.exists() or out.read_bytes() == b""
+    rep = json.loads(report.read_text())
+    assert rep["preemption"]["stop_requested"] is True
+    assert rep["preemption"]["boundary"] is not None
+    monkeypatch.undo()
+
+    rc = _run(["cluster", "--genome-fasta-files", *genomes,
+               "--resume", "--checkpoint-dir", str(ck),
+               "--output-cluster-definition", str(out),
+               "--run-report", str(report)])
+    assert rc == 0
+    assert out.exists()
+    rep = json.loads(report.read_text())
+    assert rep["preemption"]["resumed_from"] == str(ck)
+    assert rep["preemption"]["prior_interruptions"] == 1
+
+    # and the resumed output equals an uninterrupted run's
+    out2 = tmp_path / "c2.tsv"
+    rc = _run(["cluster", "--genome-fasta-files", *genomes,
+               "--checkpoint-dir", str(tmp_path / "ck2"),
+               "--output-cluster-definition", str(out2)])
+    assert rc == 0
+    assert out.read_bytes() == out2.read_bytes()

@@ -6,6 +6,7 @@ speedup — identical results, keyed on file identity, invalidated when
 the FASTA changes.
 """
 
+import os
 import shutil
 
 import numpy as np
@@ -82,3 +83,132 @@ def test_profilestore_cache_identical_profiles(tmp_path, ref_data):
     np.testing.assert_array_equal(p1.flat_hashes, p2.flat_hashes)
     np.testing.assert_array_equal(p1.ref_set, p2.ref_set)
     np.testing.assert_array_equal(p1.markers, p2.markers)
+
+
+# -- corruption recovery (miss-and-repair, never a wrong sketch) ------
+
+
+def _seed_entry(tmp_path, arrays=None):
+    fasta = tmp_path / "g.fna"
+    _write_fasta(str(fasta), "ACGT" * 500)
+    cache = diskcache.CacheDir(str(tmp_path / "cache"))
+    cache.store(str(fasta), "x", {},
+                arrays or {"a": np.arange(8, dtype=np.uint64)})
+    (entry,) = [f for f in (tmp_path / "cache").iterdir()
+                if f.suffix == ".npz"]
+    return fasta, cache, entry
+
+
+def test_truncated_entry_is_miss_and_repair(tmp_path, caplog):
+    import logging
+
+    fasta, cache, entry = _seed_entry(tmp_path)
+    entry.write_bytes(entry.read_bytes()[: entry.stat().st_size // 2])
+    with caplog.at_level(logging.WARNING):
+        assert cache.load(str(fasta), "x", {}) is None
+    assert "corrupt cache entry" in caplog.text
+    assert not entry.exists()  # dropped, ready for restore
+    cache.store(str(fasta), "x", {}, {"a": np.arange(8,
+                                                     dtype=np.uint64)})
+    back = cache.load(str(fasta), "x", {})
+    np.testing.assert_array_equal(back["a"], np.arange(8))
+
+
+def test_flipped_data_byte_is_miss_not_wrong_sketch(tmp_path):
+    """Corrupting actual array bytes must never return wrong data —
+    either the zip member CRC or the embedded __check__ rejects it."""
+    fasta, cache, entry = _seed_entry(tmp_path)
+    raw = bytearray(entry.read_bytes())
+    # the array payload sits after the npz member header; flip a byte
+    # inside the stored uint64 data
+    idx = raw.find((3).to_bytes(8, "little"))
+    assert idx > 0
+    raw[idx] ^= 0xFF
+    entry.write_bytes(bytes(raw))
+    assert cache.load(str(fasta), "x", {}) is None
+    assert not entry.exists()
+
+
+def test_bad_embedded_checksum_is_miss(tmp_path):
+    """An entry whose __check__ disagrees with its content is dropped
+    (covers semantic corruption zipfile-level CRCs can't see)."""
+    fasta, cache, entry = _seed_entry(tmp_path)
+    with np.load(entry) as z:
+        payload = {name: z[name] for name in z.files}
+    payload["__check__"] = np.array([12345], dtype=np.uint64)
+    from galah_tpu.io import atomic
+
+    atomic.write_npz(str(entry), payload)
+    assert cache.load(str(fasta), "x", {}) is None
+    assert cache.misses == 1
+
+
+def test_legacy_entry_without_checksum_still_loads(tmp_path):
+    """Pre-checksum entries (no __check__ member) stay readable."""
+    fasta, cache, entry = _seed_entry(tmp_path)
+    with np.load(entry) as z:
+        payload = {n: z[n] for n in z.files if n != "__check__"}
+    from galah_tpu.io import atomic
+
+    atomic.write_npz(str(entry), payload)
+    back = cache.load(str(fasta), "x", {})
+    np.testing.assert_array_equal(back["a"], np.arange(8))
+
+
+def test_stale_tmp_debris_swept_on_open(tmp_path):
+    import os
+    import time as _time
+
+    cachedir = tmp_path / "cache"
+    cachedir.mkdir()
+    stale = cachedir / "x-deadbeef.npz.123.tmp"
+    stale.write_bytes(b"half-written entry")
+    os.utime(stale, (1, 1))  # older than the shared-dir age gate
+    fresh = cachedir / "y-cafef00d.npz.456.tmp"
+    fresh.write_bytes(b"maybe a live concurrent writer")
+    diskcache.CacheDir(str(cachedir))
+    assert not stale.exists()
+    assert fresh.exists()  # age gate: young .tmp left alone
+
+
+def test_reserved_check_key_rejected(tmp_path):
+    fasta = tmp_path / "g.fna"
+    _write_fasta(str(fasta), "ACGT" * 50)
+    cache = diskcache.CacheDir(str(tmp_path / "cache"))
+    import pytest
+
+    with pytest.raises(ValueError, match="reserved"):
+        cache.store(str(fasta), "x", {},
+                    {"__check__": np.zeros(1), "a": np.zeros(1)})
+
+
+def test_crash_during_put_leaves_no_entry(tmp_path):
+    """A writer killed mid-store (GALAH_FI kill inside the atomic
+    write) must leave no entry under the final name — the next run
+    misses and recomputes instead of loading a torn file."""
+    import subprocess
+    import sys
+
+    fasta = tmp_path / "g.fna"
+    _write_fasta(str(fasta), "ACGT" * 500)
+    cachedir = tmp_path / "cache"
+    code = (
+        "import numpy as np\n"
+        "from galah_tpu.io import diskcache\n"
+        f"cache = diskcache.CacheDir({str(cachedir)!r})\n"
+        f"cache.store({str(fasta)!r}, 'x', {{}},\n"
+        "            {'a': np.arange(8, dtype=np.uint64)})\n"
+    )
+    env = dict(os.environ)
+    env["GALAH_FI"] = "site=io.atomic.write[cache.x];kind=kill;prob=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, timeout=120)
+    assert proc.returncode == 137, proc.stderr.decode()
+    assert list(cachedir.glob("*.npz")) == []  # nothing committed
+    cache = diskcache.CacheDir(str(cachedir))
+    assert cache.load(str(fasta), "x", {}) is None  # clean miss
+    cache.store(str(fasta), "x", {},
+                {"a": np.arange(8, dtype=np.uint64)})
+    back = cache.load(str(fasta), "x", {})
+    np.testing.assert_array_equal(back["a"], np.arange(8))

@@ -41,10 +41,16 @@ def test_append_read_roundtrip(tmp_path):
     assert skipped == 0
     assert [e["metrics"]["run.duration_s"] for e in entries] == \
         [10.0, 11.0, 12.0, 13.0]
-    # every line is one complete JSON object
+    # every line is one complete checksum-framed JSON object
+    # (<compact-json>\t<crc32hex>, the io/atomic.py append framing)
+    from galah_tpu.io import atomic
+
     with open(path) as fh:
         for line in fh:
-            assert isinstance(json.loads(line), dict)
+            payload, sep, _crc = line.rstrip("\n").rpartition(
+                atomic.FRAME_SEP)
+            assert sep == atomic.FRAME_SEP
+            assert isinstance(json.loads(payload), dict)
 
 
 def test_read_missing_file_is_empty_ledger(tmp_path):
