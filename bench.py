@@ -587,6 +587,45 @@ def _run_engine_rounds_stage(stages, errors):
         errors.append(f"engine_rounds: {type(e).__name__}: {e}")
 
 
+def _run_ingest_variants_stage(stages, errors):
+    """Storage-bound ingest->sketch matrix in a subprocess
+    (scripts/bench_ingest.py --variants): end-to-end Mbp/s by
+    strategy x workers x gzip over a >= 1 Gbp multi-file corpus,
+    against the serial-prologue baseline (read everything, then one
+    batched sketch pass — the pre-streaming pipeline shape), with the
+    host/device cost split. The headline scalars are flattened into
+    stages so _finalize_obs mirrors them into bench.* gauges and the
+    perf ledger gates ingest-rate regressions. Same isolation
+    rationale as the other matrices: self-budgeting script,
+    subprocess timeout; the corpus is CPU-pinned host work either
+    way."""
+    _INGEST_COST = 420
+    if not _admit(_INGEST_COST, "ingest_variants", errors):
+        return
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "scripts", "bench_ingest.py"),
+             "--variants", "--budget", str(_INGEST_COST - 90)],
+            capture_output=True, text=True,
+            timeout=_INGEST_COST, cwd=here)
+        data = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("INGEST_JSON "):
+                data = json.loads(line[len("INGEST_JSON "):])
+        if data is None:
+            raise RuntimeError(
+                f"rc={proc.returncode}: {proc.stderr[-400:]}")
+        stages["ingest_variants"] = data
+        for k in ("overlapped_mbp_s", "serial_prologue_mbp_s",
+                  "speedup_vs_serial"):
+            if isinstance(data.get(k), (int, float)):
+                stages[f"ingest_{k}"] = data[k]
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"ingest_variants: {type(e).__name__}: {e}")
+
+
 def run_ladder_stages(stages, errors):
     """North-star-relevant e2e evidence in the driver artifact itself.
 
@@ -808,6 +847,9 @@ def main():
         # no-tunnel capture is a documented negative, not a silence.
         _run_pairlist_variants_stage(stages, errors, interpret=True)
         _run_fragment_variants_stage(stages, errors, interpret=True)
+        # Ingest->sketch is host-side work: the matrix is as real on
+        # the cpu-fallback branch as on the device one.
+        _run_ingest_variants_stage(stages, errors)
         _finalize_obs(result, started_at)
         print(json.dumps(result))
         return
@@ -904,6 +946,10 @@ def main():
     # pack sweep (launches per pair, job/span occupancy), xla and C
     # baselines, bare-kernel dispatch cost. Same subprocess isolation.
     _run_fragment_variants_stage(stages, errors)
+
+    # 4f. Storage-bound ingest->sketch matrix: streamed pipeline vs
+    # the serial-prologue baseline over a >= 1 Gbp corpus.
+    _run_ingest_variants_stage(stages, errors)
 
     # 5. Sketching throughput on real FASTA bytes, both hash algos —
     # each with its own watchdog so one failure never loses the other.

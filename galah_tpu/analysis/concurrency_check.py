@@ -68,6 +68,7 @@ THREADED_MODULES = (
     "galah_tpu/resilience/policy.py",
     "galah_tpu/resilience/faults.py",
     "galah_tpu/utils/timing.py",
+    "galah_tpu/ops/sketch_stream.py",
 )
 
 #: Method calls that mutate their receiver in place.

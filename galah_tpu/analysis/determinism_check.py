@@ -64,6 +64,7 @@ STRATEGY_MODULES = (
     "galah_tpu/ops/fragment_ani.py",
     "galah_tpu/ops/pallas_fragment.py",
     "galah_tpu/ops/greedy_select.py",
+    "galah_tpu/ops/sketch_stream.py",
 )
 
 _WHERE_CALLS = frozenset({

@@ -136,6 +136,22 @@ def _lattice() -> List[Tuple[str, str, Callable[[], object],
         add("pallas_sketch.murmur3_k21_pallas", f"n={n},uint64",
             murmur, sds((n,), u64), sds((n,), u64), sds((n,), u64))
 
+    # fused hash+bottom-k sketch kernel: job-bucket x span-bucket
+    # lattice at the (BLOCK_SUB x LANES)-block geometry, both hash
+    # algorithms (murmur3 ships 3 key words per position, tpufast 1);
+    # output is the (jobs, R_REG, CAND_SUB * LANES) candidate file
+    fused = get("galah_tpu.ops.pallas_sketch", "fused_sketch_candidates")
+    _fb = 512 * 128  # BLOCK_SUB * LANES positions per kernel block
+    for jobs, span, algo, n_words in ((8, 1, "murmur3", 3),
+                                      (8, 2, "murmur3", 3),
+                                      (16, 1, "tpufast", 1)):
+        w = span * _fb
+        add("pallas_sketch.fused_sketch_candidates",
+            f"jobs={jobs},span={span},{algo},uint64", fused,
+            tuple(sds((jobs, w), u64) for _ in range(n_words)),
+            sds((jobs, w), jnp.bool_),
+            algo=algo, seed=0, interpret=True)
+
     # HLL union tiles: Mosaic kernel and its XLA fallback twin must
     # keep identical signatures
     for br, bc, m in ((8, 8, 1024), (64, 128, 4096)):

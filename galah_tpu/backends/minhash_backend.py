@@ -18,9 +18,7 @@ from galah_tpu.config import Defaults
 from galah_tpu.io import diskcache
 from galah_tpu.io.diskcache import CacheDir
 from galah_tpu.io.fasta import read_genome
-from galah_tpu.ops import hashing
 from galah_tpu.ops.minhash import (
-    BATCH_BUDGET,
     sketch_genome_device,
     sketch_genomes_device_batch,
     sketch_matrix,
@@ -137,40 +135,16 @@ class MinHashPreclusterer(PreclusterBackend):
         return "finch"
 
     def _sketch_paths(self, paths: Sequence[str]) -> dict:
-        """path -> sketch for (deduped) paths: cache probe + prefetch +
-        batched device sketching. Worker threads only COMPUTE sketches;
-        the consumer loop is the single writer into the store and disk
-        cache."""
-        from galah_tpu.io.prefetch import (
-            probe_and_prefetch,
-            process_stream,
-        )
+        """path -> sketch for (deduped) paths via the streaming
+        ingest->sketch pipeline (ops/sketch_stream.py): bounded-depth
+        prefetch ingest, double-buffered staging, and the resolved
+        sketch strategy (fused Pallas / chunked XLA / C bottom-k), all
+        overlapped. Worker threads only COMPUTE; the stream inserts
+        into the store on this (consumer) thread."""
+        from galah_tpu.ops.sketch_stream import iter_path_sketches
 
-        from galah_tpu.resilience import dispatch as rdispatch
-
-        def sketch_batch(buf):
-            # Guarded device dispatch: retries transient failures and,
-            # after repeated ones, demotes this site to the per-genome
-            # CPU sketch path for the rest of the run (stage report:
-            # demoted[dispatch.sketch-minhash]).
-            return rdispatch.run(
-                "dispatch.sketch-minhash",
-                lambda: self.store.sketch_batch_only(buf),
-                fallback=lambda: [self.store.sketch_only(g)
-                                  for _p, g in buf],
-                validate=rdispatch.expect_len(len(buf)))
-
-        by_path, miss_iter = probe_and_prefetch(
-            paths, self.store.get_cached, read_genome,
-            depth=max(2, self.threads))
-        for p, s in process_stream(
-                miss_iter, lambda g: g.codes.shape[0], BATCH_BUDGET,
-                sketch_batch,
-                lambda _path, g: self.store.sketch_only(g),
-                batched=hashing.device_transfer_bound(),
-                workers=self.threads):
-            by_path[p] = self.store.insert(p, s)
-        return by_path
+        return dict(iter_path_sketches(paths, self.store,
+                                       threads=self.threads))
 
     def _sketch_matrix_multihost(self, genome_paths: Sequence[str]):
         """Per-host ingestion: each host reads + sketches only its
@@ -197,7 +171,60 @@ class MinHashPreclusterer(PreclusterBackend):
         index = {path: i for i, path in enumerate(unique)}
         return mat[[index[p] for p in genome_paths]]
 
+    def _streamed_pair_pass(self, genome_paths: Sequence[str]):
+        """Overlapped ingest->sketch->pair pass: consume the sketch
+        stream in row blocks and evaluate each block against all done
+        rows while the stream keeps ingesting ahead — no serial sketch
+        prologue. Engaged only where it is bit-identical to the staged
+        path AND the overlap can win: single process, unique paths,
+        below the sparse-screen crossover (the sparse pair pass needs
+        the full matrix up front), and a device sketch strategy (the
+        single-device-CPU C path keeps its historical shape).
+        Returns the pair dict, or None when not engaged."""
+        import jax
+
+        from galah_tpu.ops.collision import sparse_screen_min_n
+        from galah_tpu.ops.pairwise import threshold_pairs_streamed
+        from galah_tpu.ops.sketch_stream import (
+            iter_sketch_row_blocks,
+            resolve_sketch_strategy,
+        )
+        from galah_tpu.parallel import distributed
+
+        n = len(genome_paths)
+        strategy, _ = resolve_sketch_strategy()
+        if (distributed.process_count() > 1
+                or strategy == "c"
+                or n >= sparse_screen_min_n()
+                or len(dict.fromkeys(genome_paths)) != n):
+            return None
+        mesh = None
+        if jax.device_count() > 1:
+            from galah_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh()
+        logger.info(
+            "Streaming %d genomes: ingest+sketch overlapped with the "
+            "pair pass (strategy %s) ..", n, strategy)
+        with timing.stage("sketch-pairwise-streamed"):
+            # strategy=None: the stream re-resolves, preserving the
+            # explicit-pin vs AUTO failure semantics
+            blocks = iter_sketch_row_blocks(
+                genome_paths, self.store, threads=self.threads)
+            return threshold_pairs_streamed(
+                blocks, n, k=self.k, min_ani=self.min_ani,
+                sketch_size=self.sketch_size, mesh=mesh)
+
     def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
+        pairs = self._streamed_pair_pass(genome_paths)
+        if pairs is not None:
+            cache = PairDistanceCache()
+            for (i, j), ani in pairs.items():
+                cache.insert((i, j), ani)
+            logger.info(
+                "Found %d pairs passing precluster threshold %.4f",
+                len(cache), self.min_ani)
+            return cache
         logger.info(
             "Sketching MinHash representations of %d genomes on device ..",
             len(genome_paths))

@@ -136,6 +136,15 @@ def cluster(
             "workload.sketch_k",
             help="MinHash sketch size of the precluster backend").set(
             float(sketch_k))
+    ingest_threads = getattr(preclusterer, "threads", None)
+    if ingest_threads:
+        from galah_tpu.ops.sketch_stream import ingest_depth
+
+        obs_metrics.gauge(
+            "workload.ingest_depth",
+            help="Streaming ingest look-ahead depth "
+                 "(GALAH_TPU_INGEST_DEPTH or max(2, threads))").set(
+            float(ingest_depth(int(ingest_threads))))
 
     pre_cache = checkpoint.load_distances() if checkpoint else None
     if pre_cache is None:

@@ -148,6 +148,17 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "the AUTO heuristic (decisions are bit-identical; a "
               "pinned strategy's failures propagate instead of "
               "demoting)"),
+    Flag("GALAH_TPU_SKETCH_STRATEGY", section="kernel",
+         choices=("fused", "xla", "c"),
+         help="Pin the sketch-stage strategy (fused Pallas "
+              "hash+bottom-k kernel / chunked-XLA device path / C "
+              "bottom-k sketcher) instead of the AUTO heuristic "
+              "(sketches are bit-identical; a pinned strategy's "
+              "failures propagate instead of demoting)"),
+    Flag("GALAH_TPU_INGEST_DEPTH", kind="int", section="kernel",
+         help="Look-ahead depth of the streaming ingest stage (parsed "
+              "genomes in flight ahead of the sketch launches); unset "
+              "uses max(2, threads)"),
     Flag("GALAH_TPU_PALLAS_HASH", kind="bool", section="kernel",
          help="1 forces the quarantined Mosaic murmur3 kernel, 0 "
               "forces the XLA u64 emulation; unset uses the "

@@ -15,10 +15,13 @@ from __future__ import annotations
 
 import dataclasses
 import gzip
+import logging
 import zlib
 from typing import List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 class BadGenomeError(ValueError):
@@ -90,6 +93,29 @@ def _compute_n50(lengths: np.ndarray) -> int:
 
 _CINGEST = None
 _CINGEST_TRIED = False
+_CINGEST_ERR: list = [None]
+
+
+def _note_c_fallback(what: str, err: BaseException, path: str = "") -> None:
+    """Make the ~10x slower numpy-parser fallback visible: one WARNING
+    per process per failure site, a resilience event per occurrence,
+    and an ``ingest.c_fallback`` counter so run_report.json shows how
+    many genomes went down the slow path."""
+    from galah_tpu.obs import events
+    from galah_tpu.obs import metrics as obs_metrics
+
+    events.warn_once(
+        logger,
+        "C FASTA ingest %s (%s: %s); falling back to the ~10x slower "
+        "numpy parser", what, type(err).__name__, err,
+        key=f"ingest.c_fallback:{what}")
+    events.record("ingest-c-fallback", what=what, path=path,
+                  error=f"{type(err).__name__}: {err}")
+    obs_metrics.counter(
+        "ingest.c_fallback",
+        help="genome reads served by the numpy parser because the C "
+             "ingest fast path failed (build/load or per-file parse)",
+        unit="reads").inc()
 
 
 def _get_cingest():
@@ -101,8 +127,9 @@ def _get_cingest():
         try:
             from galah_tpu.io import _cingest
             _CINGEST = _cingest
-        except Exception:
+        except Exception as e:
             _CINGEST = None
+            _CINGEST_ERR[0] = e
     return _CINGEST
 
 
@@ -154,8 +181,13 @@ def read_genome(path: str, with_codes: bool = True) -> Genome:
         if cingest is not None:
             try:
                 return _read_genome_c(cingest, path, with_codes)
-            except Exception:
-                pass  # fall back to the numpy path on any C-side failure
+            except Exception as e:
+                # fall back to the numpy path on any C-side failure,
+                # but never silently: the slow path must show up in obs
+                _note_c_fallback("parse failed", e, path=path)
+        elif _CINGEST_ERR[0] is not None:
+            _note_c_fallback("build/load failed", _CINGEST_ERR[0],
+                             path=path)
         return read_genome_numpy(path, with_codes)
 
     try:

@@ -233,36 +233,22 @@ def _tpufast_mix(x: jax.Array, seed: int) -> jax.Array:
     return x
 
 
-def _hash_core(
+def _canonical_core(
     cs: jax.Array,          # uint8 (C,) sanitized codes, 0-3 everywhere
     valid1: jax.Array,      # bool (C,) False at ambiguous/pad positions
     offsets: jax.Array,     # int32 (B,) contig start offsets (padded with
                             # a value > any position; see iter_chunk_hashes)
     pos: jax.Array,         # int32 scalar: global position of cs[0]
     k: int,
-    seed: int,
-    algo: str,
-) -> jax.Array:
-    """Hash every canonical k-mer starting in this chunk -> (C-k+1,) uint64.
+):
+    """Window packing + boundary masking + canonical orientation: the
+    hash-independent front half of `_hash_core`, shared with the fused
+    Pallas sketch preamble (`canonical_kmer_words`).
 
-    Positions whose window contains an ambiguous base or crosses a contig
-    boundary produce HASH_SENTINEL. The caller overlaps consecutive chunks
-    by k-1 positions so every k-mer is seen exactly once. The contig id
-    per position is derived ON DEVICE from the (tiny) offsets array —
-    uploading a per-position boundary array would quadruple the
-    host->device traffic of the 1-byte codes.
-
-    Everything is formulated over 1-D shifted slices of `cs` (k static
-    slices, fused elementwise chains) — the earlier (n_win, k) 2-D
-    formulation materialized hundreds of MB of uint64 intermediates per
-    chunk and was HBM-bound.
-
-    `algo` selects the hash: "murmur3" reproduces the reference's finch
-    contract bit-for-bit (canonical ASCII k-mer, murmur3 x64_128 h1,
-    reference: src/finch.rs:33-47; the golden 0.9808188 depends on it);
-    "tpufast" hashes the canonical 2-bit packed k-mer with a
-    multiply-free mixer — statistically equivalent MinHash estimates at
-    ~20x the device throughput (the VPU has no fast integer multiply).
+    Returns (fwd, rev, valid, use_fwd) over the C-k+1 window positions:
+    the forward and reverse-complement 2-bit packed windows (uint64),
+    the window validity (no ambiguous base, no contig crossing), and the
+    canonical-orientation select.
     """
     n = cs.shape[0]
     n_win = n - k + 1
@@ -302,22 +288,64 @@ def _hash_core(
     # ASCII order, so integer compare == string compare at fixed length
     # (k <= 32 bases in 64 bits).
     use_fwd = fwd <= rev
+    return fwd, rev, valid, use_fwd
+
+
+def _canonical_bytes(cs, use_fwd, k: int, n_win: int):
+    """Canonical ASCII byte vectors for the murmur contract: byte j is
+    fwd ? ascii(cs[j]) : ascii(3-cs[k-1-j]). The select chains run ONCE
+    over the full chunk; the per-byte views are slices of those two
+    arrays."""
+    af = _ascii64(cs)
+    ar = _ascii64(jnp.uint8(3) - cs)
+    return [
+        jnp.where(use_fwd, af[j:j + n_win],
+                  ar[k - 1 - j:k - 1 - j + n_win])
+        for j in range(k)
+    ]
+
+
+def _hash_core(
+    cs: jax.Array,          # uint8 (C,) sanitized codes, 0-3 everywhere
+    valid1: jax.Array,      # bool (C,) False at ambiguous/pad positions
+    offsets: jax.Array,     # int32 (B,) contig start offsets (padded with
+                            # a value > any position; see iter_chunk_hashes)
+    pos: jax.Array,         # int32 scalar: global position of cs[0]
+    k: int,
+    seed: int,
+    algo: str,
+) -> jax.Array:
+    """Hash every canonical k-mer starting in this chunk -> (C-k+1,) uint64.
+
+    Positions whose window contains an ambiguous base or crosses a contig
+    boundary produce HASH_SENTINEL. The caller overlaps consecutive chunks
+    by k-1 positions so every k-mer is seen exactly once. The contig id
+    per position is derived ON DEVICE from the (tiny) offsets array —
+    uploading a per-position boundary array would quadruple the
+    host->device traffic of the 1-byte codes.
+
+    Everything is formulated over 1-D shifted slices of `cs` (k static
+    slices, fused elementwise chains) — the earlier (n_win, k) 2-D
+    formulation materialized hundreds of MB of uint64 intermediates per
+    chunk and was HBM-bound.
+
+    `algo` selects the hash: "murmur3" reproduces the reference's finch
+    contract bit-for-bit (canonical ASCII k-mer, murmur3 x64_128 h1,
+    reference: src/finch.rs:33-47; the golden 0.9808188 depends on it);
+    "tpufast" hashes the canonical 2-bit packed k-mer with a
+    multiply-free mixer — statistically equivalent MinHash estimates at
+    ~20x the device throughput (the VPU has no fast integer multiply).
+    """
+    n = cs.shape[0]
+    n_win = n - k + 1
+    fwd, rev, valid, use_fwd = _canonical_core(cs, valid1, offsets, pos, k)
 
     if algo == "tpufast":
         # the canonical 2-bit packed key is already in hand — no ASCII
         # expansion, no murmur: just the multiply-free mixer
         hashes = _tpufast_mix(jnp.where(use_fwd, fwd, rev), seed)
     elif algo == "murmur3":
-        # canonical ASCII byte j: fwd ? ascii(cs[j]) : ascii(3-cs[k-1-j]).
-        # The select chains run ONCE over the full chunk; the per-byte
-        # views below are slices of those two arrays.
-        af = _ascii64(cs)
-        ar = _ascii64(jnp.uint8(3) - cs)
-        cb = [
-            jnp.where(use_fwd, af[j:j + n_win],
-                      ar[k - 1 - j:k - 1 - j + n_win])
-            for j in range(k)
-        ]
+        cb = _canonical_bytes(cs, use_fwd, k, n_win)
         if k == 21:
             # Opt-in Mosaic hash state machine (read at FIRST TRACE of
             # the enclosing jit — set before first use, or
@@ -343,6 +371,51 @@ def _hash_core(
     return jnp.where(valid, hashes, HASH_SENTINEL)
 
 
+def canonical_kmer_words(cs, valid1, offsets, pos, k: int, algo: str):
+    """Canonical k-mer KEY WORDS + window validity — the front half of
+    `_hash_core` (window packing, boundary masking, canonical selection)
+    without the hash, for the fused Pallas sketch kernel
+    (ops/pallas_sketch.fused_sketch_candidates) which hashes in-kernel.
+
+    Returns (words, valid): `words` is a tuple of uint64 (C-k+1,)
+    arrays — the assembled murmur3 key words (k1, k2, tail) for
+    algo="murmur3" (k must be 21: the fused kernel bakes the 21-byte
+    state machine), or the single canonical 2-bit packed k-mer for
+    algo="tpufast". Bit-identical inputs to what `_hash_core` feeds its
+    hash stage, so fused sketches match the XLA/C paths exactly.
+    """
+    n_win = cs.shape[0] - k + 1
+    fwd, rev, valid, use_fwd = _canonical_core(cs, valid1, offsets, pos, k)
+    if algo == "tpufast":
+        return (jnp.where(use_fwd, fwd, rev),), valid
+    if algo == "murmur3":
+        if k != 21:
+            raise ValueError(
+                f"fused murmur3 sketching requires k=21, got k={k}")
+        from galah_tpu.ops.pallas_sketch import assemble_k21_words
+
+        cb = _canonical_bytes(cs, use_fwd, k, n_win)
+        return assemble_k21_words(cb), valid
+    raise ValueError(f"unknown hash algorithm {algo!r}")
+
+
+def canonical_kmer_words_batch(packed, ambits, offsets, k, algo):
+    """Batched-row twin of `canonical_kmer_words` over packed genome
+    groups (same row layout as canonical_kmer_hashes_batch): (G, C/4)
+    packed + (G, C/8) mask + (G, B) offsets -> (words, valid) with each
+    word (G, C-k+1) uint64 and valid (G, C-k+1) bool.
+
+    Unjitted building block: the fused sketch path embeds it in the
+    same jit as the Pallas launch so XLA fuses the unpack/select chains
+    into the kernel's operand production.
+    """
+    def row(p, a, o):
+        cs, v1 = _unpack_codes(p, a)
+        return canonical_kmer_words(cs, v1, o, jnp.int32(0), k, algo)
+
+    return jax.vmap(row)(packed, ambits, offsets)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "seed", "algo"))
 def canonical_kmer_hashes_chunk(
     codes: jax.Array,       # uint8 (C,), 0-3 valid, 255 ambiguous/pad
@@ -363,8 +436,8 @@ def canonical_kmer_hashes_chunk(
                       k, seed, algo)
 
 
-def _packed_core(packed, ambits, offsets, pos, k, seed, algo):
-    """Unpack 2-bit codes + ambiguity bitmask on device, then hash."""
+def _unpack_codes(packed, ambits):
+    """2-bit codes + ambiguity bitmask -> (codes uint8 (C,), valid bool)."""
     p = packed
     cs = jnp.stack(
         [(p >> jnp.uint8(6)) & jnp.uint8(3),
@@ -375,7 +448,13 @@ def _packed_core(packed, ambits, offsets, pos, k, seed, algo):
     amb = jnp.stack(
         [(a >> jnp.uint8(s)) & jnp.uint8(1) for s in range(7, -1, -1)],
         axis=-1).reshape(-1)
-    return _hash_core(cs, amb == jnp.uint8(0), offsets, pos, k, seed, algo)
+    return cs, amb == jnp.uint8(0)
+
+
+def _packed_core(packed, ambits, offsets, pos, k, seed, algo):
+    """Unpack 2-bit codes + ambiguity bitmask on device, then hash."""
+    cs, valid1 = _unpack_codes(packed, ambits)
+    return _hash_core(cs, valid1, offsets, pos, k, seed, algo)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "seed", "algo"))

@@ -643,3 +643,118 @@ def _threshold_pairs_single(
         for a, b, v in zip(gi.tolist(), gj.tolist(), ani.tolist()):
             out[(int(a), int(b))] = float(v)
     return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sketch_size", "k", "row_tile"))
+def _stripe_stats(rows_mat: jax.Array, cols_mat: jax.Array,
+                  sketch_size: int, k: int,
+                  row_tile: int) -> Tuple[jax.Array, jax.Array]:
+    """(common, total) int32 of EVERY done row against one incoming
+    column block — the per-block device dispatch of the streamed pair
+    pass, lax.map over row tiles to bound the vmap intermediates."""
+    n_rt = rows_mat.shape[0] // row_tile
+
+    def one_tile(t):
+        rows = jax.lax.dynamic_slice_in_dim(
+            rows_mat, t * row_tile, row_tile, axis=0)
+        c, tt = tile_stats(rows, cols_mat, sketch_size, k)
+        return c.astype(jnp.int32), tt.astype(jnp.int32)
+
+    c, t = jax.lax.map(one_tile, jnp.arange(n_rt))
+    b = cols_mat.shape[0]
+    return c.reshape(n_rt * row_tile, b), t.reshape(n_rt * row_tile, b)
+
+
+def threshold_pairs_streamed(
+    blocks_iter,
+    n: int,
+    k: int,
+    min_ani: float,
+    sketch_size: int,
+    mesh: "Optional[Mesh]" = None,
+    block: int = 256,
+    row_tile: int = 64,
+) -> dict[tuple[int, int], float]:
+    """`threshold_pairs` over an ARRIVING sketch stream: consume
+    (r0, rows) blocks (ops/sketch_stream.iter_sketch_row_blocks) and
+    evaluate each block against every row seen so far while the stream
+    keeps ingesting ahead — the pair pass overlaps ingest+sketch
+    instead of waiting for the full matrix.
+
+    Every i<j pair is covered exactly once (as a stripe entry when
+    block(j) arrives: rows [0, r0+b) x cols [r0, r0+b), filtered to
+    i < j), and the exact f64 integer-Jaccard check runs on host over
+    the integer stats — so the result dict is IDENTICAL to
+    `threshold_pairs(full_matrix, ...)` by construction. Done-row
+    counts are padded to powers of two (>= the tiling quantum) to
+    bound the jit variants at O(log n); sentinel padding rows/cols are
+    killed by the `common > 0` guard (a sentinel row intersects
+    nothing). With a multi-device `mesh`, each stripe is computed with
+    rows sharded over the mesh (parallel/mesh.sharded_stripe_stats) —
+    bit-identical integers either way.
+    """
+    j_thr = ani_to_jaccard(min_ani, k)
+    n_dev = mesh.devices.size if mesh is not None else 1
+    if n_dev > 1 and (n_dev & (n_dev - 1)):
+        # non-pow2 mesh would break the pow2 row padding below; the
+        # single-device stripe is always correct, just unsharded
+        mesh, n_dev = None, 1
+    quantum = row_tile * n_dev
+
+    done = np.full((n, sketch_size), np.uint64(SENTINEL),
+                   dtype=np.uint64)
+    out: dict[tuple[int, int], float] = {}
+    r1 = 0
+    stripes = 0
+    for r0, rows in blocks_iter:
+        bsz = rows.shape[0]
+        assert r0 == r1, f"streamed blocks out of order: {r0} != {r1}"
+        done[r0:r0 + bsz] = rows
+        r1 = r0 + bsz
+
+        # pow2 (>= quantum) done-row padding and fixed column width:
+        # O(log n) distinct dispatch shapes across the whole stream.
+        r_pad = quantum
+        while r_pad < r1:
+            r_pad <<= 1
+        cols = np.full((block, sketch_size), np.uint64(SENTINEL),
+                       dtype=np.uint64)
+        cols[:bsz] = rows
+        timing.dispatch()
+        if mesh is not None:
+            from galah_tpu.parallel.mesh import sharded_stripe_stats
+
+            common, total = sharded_stripe_stats(
+                done[:r1], cols, sketch_size=sketch_size, k=k,
+                mesh=mesh, row_tile=row_tile, r_pad=r_pad)
+        else:
+            jrows = jnp.asarray(
+                np.vstack([done[:r1],
+                           np.full((r_pad - r1, sketch_size),
+                                   np.uint64(SENTINEL), np.uint64)]))
+            common, total = _stripe_stats(
+                jrows, jnp.asarray(cols), sketch_size=sketch_size,
+                k=k, row_tile=row_tile)
+        timing.dispatch(sync=True)
+        stripes += 1
+
+        common = np.asarray(common).astype(np.int64)
+        total = np.asarray(total).astype(np.int64)
+        gi = np.arange(common.shape[0])[:, None]
+        gj = r0 + np.arange(block)[None, :]
+        # exact host-side threshold + ANI; common > 0 kills sentinel
+        # padding rows/cols (and the degenerate empty-sketch pairs,
+        # matching the dense paths' device prefilter)
+        keep = ((gi < gj) & (gj < r1) & (common > 0)
+                & (common.astype(np.float64) >= j_thr * total))
+        ki, kj = np.nonzero(keep)
+        ani = stats_to_ani_f64(common[keep], total[keep], k)
+        for a, b, v in zip(ki.tolist(), (r0 + kj).tolist(),
+                           ani.tolist()):
+            out[(int(a), int(b))] = float(v)
+    if r1 != n:
+        raise ValueError(
+            f"streamed pair pass saw {r1} rows, expected {n}")
+    timing.counter("pairs-streamed-stripes", stripes)
+    return out

@@ -123,6 +123,60 @@ def sharded_pair_count(
     return int(jax.jit(fn)(jnp.asarray(mat), jnp.asarray(mat)))
 
 
+def sharded_stripe_stats(
+    rows_mat: np.ndarray,
+    cols_mat: np.ndarray,
+    sketch_size: int,
+    k: int,
+    mesh: Mesh,
+    row_tile: int = 64,
+    r_pad: Optional[int] = None,
+):
+    """(common, total) int32 of every done row against one incoming
+    column block, rows sharded over the mesh — the SPMD twin of
+    ops/pairwise._stripe_stats for the streamed pair pass. Each device
+    lax.maps over the row tiles of its contiguous row shard against the
+    (replicated) column block; the integers are bit-identical to the
+    single-device stripe. `r_pad` must be a multiple of
+    mesh_size * row_tile (the caller's pow2 padding guarantees it for
+    pow2 meshes)."""
+    from galah_tpu.ops.constants import SENTINEL
+    from galah_tpu.ops.pairwise import tile_stats
+
+    n_dev = mesh.devices.size
+    if r_pad is None:
+        q = n_dev * row_tile
+        r_pad = -(-rows_mat.shape[0] // q) * q
+    if r_pad % (n_dev * row_tile):
+        raise ValueError(
+            f"r_pad {r_pad} not a multiple of mesh size {n_dev} x "
+            f"row_tile {row_tile}")
+    mat = np.full((r_pad, rows_mat.shape[1]), np.uint64(SENTINEL),
+                  dtype=np.uint64)
+    mat[:rows_mat.shape[0]] = rows_mat
+
+    def spmd(rows_shard, cols):
+        n_rt = rows_shard.shape[0] // row_tile
+
+        def one_tile(t):
+            rows = jax.lax.dynamic_slice_in_dim(
+                rows_shard, t * row_tile, row_tile, axis=0)
+            c, tt = tile_stats(rows, cols, sketch_size, k)
+            return c.astype(jnp.int32), tt.astype(jnp.int32)
+
+        c, t = jax.lax.map(one_tile, jnp.arange(n_rt))
+        b = cols.shape[0]
+        return (c.reshape(n_rt * row_tile, b),
+                t.reshape(n_rt * row_tile, b))
+
+    fn = shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P("i", None), P(None, None)),
+        out_specs=(P("i", None), P("i", None)),
+    )
+    return jax.jit(fn)(jnp.asarray(mat), jnp.asarray(cols_mat))
+
+
 def _sharded_blocked_extract(
     mesh: Mesh,
     arrays,              # tuple of replicated device arrays

@@ -102,6 +102,179 @@ def make_corpus(outdir: str, gbp: float, n_files: int) -> list:
     return paths
 
 
+def _gzip_subset(paths: list, n: int) -> list:
+    """Gzip the first n corpus files (idempotent), return the .gz paths."""
+    out = []
+    for p in paths[:n]:
+        gz = p + ".gz"
+        if not os.path.exists(gz):
+            subprocess.run(["gzip", "-1", "-k", "-f", p], check=True)
+        out.append(gz)
+    return out
+
+
+def run_ingest_variants(args) -> dict:
+    """The ingest_variants bench stage: end-to-end ingest+sketch Mbp/s
+    by strategy x workers x gzip, against the serial-prologue baseline
+    (read everything, then sketch everything — the pipeline shape
+    before the streaming subsystem), with the host/device cost split.
+
+    The full >= --sketch-gbp corpus runs through the streamed AUTO
+    pipeline (the headline + speedup_vs_serial number); the variant
+    matrix and the baselines run on a subset so the stage fits its
+    budget. Self-budgeting: once --budget seconds elapse, remaining
+    variants are skipped (recorded in "skipped")."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from galah_tpu.backends.minhash_backend import SketchStore
+    from galah_tpu.io.diskcache import CacheDir
+    from galah_tpu.io.fasta import read_genome
+    from galah_tpu.ops import sketch_stream
+
+    t_start = time.perf_counter()
+
+    def remaining() -> float:
+        if not args.budget:
+            return float("inf")
+        return args.budget - (time.perf_counter() - t_start)
+
+    # ~4.3 Mbp per file (a realistic microbial assembly, and an
+    # awkward size for pow2 chunk padding) -> multi-file corpus
+    per_file_bp = 4_300_000
+    n_files = max(8, int(args.sketch_gbp * 1e9 / per_file_bp))
+    paths = make_corpus(args.dir, args.sketch_gbp, n_files)
+    total_bp_est = int(args.sketch_gbp * 1e9)
+    subset = paths[:max(4, int(args.variants_mbp * 1e6
+                               // per_file_bp))]
+    out = {
+        "sketch_gbp": args.sketch_gbp,
+        "n_files": len(paths),
+        "per_file_mbp": round(per_file_bp / 1e6, 1),
+        "subset_files": len(subset),
+        "n_cores": os.cpu_count() or 1,
+        "variants": {},
+        "skipped": [],
+    }
+
+    def fresh_store() -> SketchStore:
+        import tempfile
+
+        return SketchStore(1000, 21,
+                           cache=CacheDir(tempfile.mkdtemp()))
+
+    def streamed(ps, workers, strategy=None):
+        store = fresh_store()
+        t0 = time.perf_counter()
+        bp = 0
+        for _p, _s in sketch_stream.iter_path_sketches(
+                ps, store, threads=workers, strategy=strategy):
+            pass
+        bp = sum(read_bp.get(p, 0) for p in ps) or None
+        dt = time.perf_counter() - t0
+        return dt, bp
+
+    read_bp: dict = {}
+
+    # 1. serial-prologue baseline (subset): read ALL files, then one
+    # batched device sketch pass — the historical device-pipeline
+    # shape this PR replaces. Host/device split = read wall vs rest.
+    label = "serial_prologue_xla"
+    if remaining() > 0:
+        store = fresh_store()
+        t0 = time.perf_counter()
+        gs = [(p, read_genome(p)) for p in subset]
+        t_read = time.perf_counter() - t0
+        for p, g in gs:
+            read_bp[p] = int(g.codes.shape[0])
+        store.sketch_batch_only(gs)
+        dt = time.perf_counter() - t0
+        bp = sum(read_bp[p] for p in subset)
+        out["variants"][label] = {
+            "mbp_s": round(bp / 1e6 / dt, 2),
+            "host_read_s": round(t_read, 2),
+            "device_sketch_s": round(dt - t_read, 2),
+            "wall_s": round(dt, 2), "workers": 1}
+        del gs
+    else:
+        out["skipped"].append(label)
+
+    # 2. serial-prologue C baseline (subset): the historical
+    # single-device-CPU shape (per-genome C bottom-k after the read).
+    label = "serial_prologue_c"
+    if remaining() > 0 and sketch_stream._c_sketcher_available():
+        store = fresh_store()
+        t0 = time.perf_counter()
+        gs = [(p, read_genome(p)) for p in subset]
+        t_read = time.perf_counter() - t0
+        for _p, g in gs:
+            store.sketch_only(g)
+        dt = time.perf_counter() - t0
+        bp = sum(read_bp[p] for p in subset)
+        out["variants"][label] = {
+            "mbp_s": round(bp / 1e6 / dt, 2),
+            "host_read_s": round(t_read, 2),
+            "host_sketch_s": round(dt - t_read, 2),
+            "wall_s": round(dt, 2), "workers": 1}
+        del gs
+    else:
+        out["skipped"].append(label)
+
+    # 3. streamed variant matrix (subset): strategy x workers. AUTO
+    # resolves per backend (the C bottom-k on this single-device CPU
+    # box); the xla pin records the chunked device path for the
+    # speedup denominator's sanity.
+    matrix = [("auto", None, 1), ("auto", None, 2),
+              ("xla", "xla", 2)]
+    for name, strat, workers in matrix:
+        label = f"streamed_{name}_w{workers}"
+        if remaining() <= 0:
+            out["skipped"].append(label)
+            continue
+        dt, _ = streamed(subset, workers, strat)
+        bp = sum(read_bp.get(p, 0) for p in subset)
+        out["variants"][label] = {
+            "mbp_s": round(bp / 1e6 / dt, 2) if bp else None,
+            "wall_s": round(dt, 2), "workers": workers,
+            "strategy": name}
+        print(json.dumps({label: out["variants"][label]}), flush=True)
+
+    # 4. gzip subset through the streamed AUTO pipeline: byte-identical
+    # sketches at whatever the decompressor adds to the host cost.
+    label = "streamed_auto_gzip"
+    if remaining() > 0:
+        gz = _gzip_subset(subset, min(8, len(subset)))
+        plain_bp = sum(read_bp.get(p, 0)
+                       for p in subset[:len(gz)])
+        dt, _ = streamed(gz, 2, None)
+        out["variants"][label] = {
+            "mbp_s": round(plain_bp / 1e6 / dt, 2) if plain_bp else None,
+            "wall_s": round(dt, 2), "workers": 2, "files": len(gz)}
+    else:
+        out["skipped"].append(label)
+
+    # 5. the >= 1 Gbp headline: the whole corpus through the streamed
+    # AUTO pipeline, overlapped ingest + sketch.
+    label = "overlapped_full_corpus"
+    if remaining() > 0:
+        dt, _ = streamed(paths, 2, None)
+        out["variants"][label] = {
+            "mbp_s": round(total_bp_est / 1e6 / dt, 2),
+            "wall_s": round(dt, 2), "workers": 2,
+            "gbp": args.sketch_gbp}
+        base = out["variants"].get("serial_prologue_xla")
+        if base and base["mbp_s"]:
+            out["speedup_vs_serial"] = round(
+                out["variants"][label]["mbp_s"] / base["mbp_s"], 2)
+        out["overlapped_mbp_s"] = out["variants"][label]["mbp_s"]
+    else:
+        out["skipped"].append(label)
+    if "serial_prologue_xla" in out["variants"]:
+        out["serial_prologue_mbp_s"] = \
+            out["variants"]["serial_prologue_xla"]["mbp_s"]
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--gbp", type=float, default=10.0)
@@ -109,7 +282,27 @@ def main() -> None:
     ap.add_argument("--dir", default="/tmp/galah_ingest_bench")
     ap.add_argument("--keep", action="store_true")
     ap.add_argument("--skip-dist", action="store_true")
+    ap.add_argument("--variants", action="store_true",
+                    help="run the ingest_variants sketch matrix "
+                         "instead of the raw-parser measurements")
+    ap.add_argument("--budget", type=float, default=0.0,
+                    help="self-budget in seconds for --variants")
+    ap.add_argument("--sketch-gbp", type=float, default=1.1,
+                    help="--variants corpus size (>= 1 Gbp for the "
+                         "acceptance headline)")
+    ap.add_argument("--variants-mbp", type=float, default=90.0,
+                    help="--variants subset size for the matrix and "
+                         "baselines")
     args = ap.parse_args()
+
+    if args.variants:
+        out = run_ingest_variants(args)
+        print("INGEST_JSON " + json.dumps(out), flush=True)
+        if not args.keep:
+            import shutil
+
+            shutil.rmtree(args.dir, ignore_errors=True)
+        return
 
     import jax
 

@@ -160,6 +160,12 @@ run_stage engine_rounds 900 python -u scripts/bench_engine_rounds.py \
 run_stage perf_check 120 python -u -m galah_tpu.cli perf check --soft
 run_stage kernel_variants 1200 python -u scripts/bench_kernel_variants.py
 run_stage sketch_variants 1200 python -u scripts/bench_sketch_variants.py
+# Storage-bound ingest->sketch matrix: streamed pipeline (fused
+# kernel on TPU) vs the serial-prologue baseline over a >= 1 Gbp
+# corpus (also runs inside bench.py; the dedicated stage survives a
+# bench.py wedge and lands in its own artifact).
+run_stage ingest_variants 600 python -u scripts/bench_ingest.py \
+  --variants --budget 480
 run_stage ladder_tpu 3600 python -u scripts/ladder_bench.py --n 1000 \
   --genome-len 100000 --skip-rung1 --hash tpufast --ani-subsample 16
 

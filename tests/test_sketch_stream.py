@@ -1,0 +1,308 @@
+"""Streaming ingest->sketch pipeline (ops/sketch_stream) and the
+overlapped pair pass it feeds.
+
+The bit-identity gate: all three sketch strategies (fused Pallas /
+chunked XLA / C bottom-k) must produce byte-identical uint64 sketches,
+gzipped input included, and the streamed pair pass must reproduce the
+staged threshold_pairs dict exactly.
+"""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from galah_tpu.io import read_genome
+from galah_tpu.ops import minhash_np
+from galah_tpu.ops import sketch_stream
+
+
+def _write_fasta(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+def _rand_seq(rng, n):
+    return "".join(rng.choice(list("ACGT"), size=n))
+
+
+def _fresh_store(tmp_path, name, sketch_size=64):
+    from galah_tpu.backends.minhash_backend import SketchStore
+    from galah_tpu.io.diskcache import CacheDir
+
+    return SketchStore(sketch_size, 21,
+                       cache=CacheDir(str(tmp_path / name)))
+
+
+def test_resolver_auto_and_pins(monkeypatch):
+    """AUTO keeps the historical winners; an env pin always wins and
+    marks itself explicit (so its failures propagate)."""
+    monkeypatch.delenv("GALAH_TPU_SKETCH_STRATEGY", raising=False)
+    resolve = sketch_stream.resolve_sketch_strategy
+    assert resolve("cpu", 1, True) == ("c", False)
+    assert resolve("cpu", 1, False) == ("xla", False)
+    assert resolve("cpu", 8, True) == ("xla", False)
+    from galah_tpu.ops import hll
+
+    monkeypatch.setattr(hll, "use_pallas_default", lambda: True)
+    assert resolve("tpu", 8, True) == ("fused", False)
+    monkeypatch.setattr(hll, "use_pallas_default", lambda: False)
+    assert resolve("tpu", 8, True) == ("xla", False)
+    for s in sketch_stream.SKETCH_STRATEGIES:
+        monkeypatch.setenv("GALAH_TPU_SKETCH_STRATEGY", s)
+        assert resolve("cpu", 1, True) == (s, True)
+
+
+def test_fused_parity_vs_numpy(tmp_path):
+    """The fused kernel (interpret mode) is bit-identical to the numpy
+    oracle across the edge shapes: sub-k contigs, all-ambiguous
+    genomes, fewer-than-sketch_size distinct k-mers (sentinel-padded
+    rows)."""
+    rng = np.random.default_rng(11)
+    bodies = {
+        # two contigs, an N, a short tail contig
+        "normal.fna": (f">a\n{_rand_seq(rng, 1500)}N"
+                       f"{_rand_seq(rng, 1500)}\n>b\n"
+                       f"{_rand_seq(rng, 40)}\n"),
+        # a contig shorter than k contributes zero windows
+        "subk.fna": (f">tiny\n{_rand_seq(rng, 10)}\n>real\n"
+                     f"{_rand_seq(rng, 800)}\n"),
+        # all-ambiguous: every window masked, empty sketch
+        "alln.fna": ">n\n" + "N" * 500 + "\n",
+        # shorter than k entirely: zero windows at all
+        "short.fna": ">s\nACGTA\n",
+        # yields far fewer than sketch_size distinct k-mers
+        "sparse.fna": f">p\n{_rand_seq(rng, 60)}\n",
+    }
+    genomes = [read_genome(_write_fasta(tmp_path, n, b))
+               for n, b in sorted(bodies.items())]
+    fused = sketch_stream.sketch_genomes_fused(
+        genomes, sketch_size=64, interpret=True)
+    for g, s in zip(genomes, fused):
+        ref = minhash_np.sketch_genome(g, sketch_size=64)
+        np.testing.assert_array_equal(ref.hashes, s.hashes)
+
+
+@pytest.mark.slow
+def test_fused_parity_span_bucket_edge(tmp_path):
+    """A genome crossing one kernel-block boundary lands in the span=2
+    bucket and still matches the numpy oracle bit-for-bit. Slow tier:
+    interpret-mode Pallas walks the multi-block grid serially (~5 min
+    on the host VM); the span logic itself also runs on every TPU
+    hardware session via the fused strategy."""
+    rng = np.random.default_rng(17)
+    g = read_genome(_write_fasta(
+        tmp_path, "span2.fna",
+        f">big\n{_rand_seq(rng, sketch_stream._BLOCK + 1000)}\n"))
+    (s,) = sketch_stream.sketch_genomes_fused([g], sketch_size=64,
+                                              interpret=True)
+    ref = minhash_np.sketch_genome(g, sketch_size=64)
+    np.testing.assert_array_equal(ref.hashes, s.hashes)
+
+
+def test_gzip_plain_identical_all_strategies(tmp_path):
+    """Gzipped and plain copies of the same sequence sketch to the
+    same bytes through the full streaming pipeline, under every
+    strategy, and all strategies agree with the numpy oracle."""
+    from galah_tpu.obs import metrics as obs_metrics
+
+    rng = np.random.default_rng(12)
+    body = (f">a\n{_rand_seq(rng, 2500)}N{_rand_seq(rng, 2500)}\n"
+            f">b\n{_rand_seq(rng, 120)}\n")
+    plain = _write_fasta(tmp_path, "g.fna", body)
+    gz = tmp_path / "g.fna.gz"
+    with gzip.open(gz, "wt") as fh:
+        fh.write(body)
+    ref = minhash_np.sketch_genome(read_genome(plain), sketch_size=64)
+
+    strategies = list(sketch_stream.SKETCH_STRATEGIES)
+    if not sketch_stream._c_sketcher_available():
+        strategies.remove("c")
+    for strategy in strategies:
+        store = _fresh_store(tmp_path, f"cache_{strategy}")
+        got = dict(sketch_stream.iter_path_sketches(
+            [plain, str(gz)], store, strategy=strategy))
+        assert set(got) == {plain, str(gz)}
+        for s in got.values():
+            np.testing.assert_array_equal(ref.hashes, s.hashes)
+    snap = obs_metrics.snapshot()
+    assert snap["workload.ingest_mbp"]["value"] > 0
+    assert snap["workload.ingest_mbp_s"]["value"] > 0
+
+
+def test_iter_path_sketches_order_dedupe_and_cache_hits(tmp_path):
+    """Unique paths come back in original order; a warm store serves
+    hits without re-reading the files."""
+    rng = np.random.default_rng(13)
+    paths = [_write_fasta(tmp_path, f"g{i}.fna",
+                          f">c\n{_rand_seq(rng, 400 + 31 * i)}\n")
+             for i in range(5)]
+    store = _fresh_store(tmp_path, "cache_order")
+    order = [p for p, _ in sketch_stream.iter_path_sketches(
+        [paths[2], paths[0], paths[2], paths[4], paths[0]], store)]
+    assert order == [paths[2], paths[0], paths[4]]
+    # warm pass: everything is a cache hit, files need not exist
+    for p in paths:
+        (tmp_path / p.split("/")[-1]).rename(tmp_path / (
+            p.split("/")[-1] + ".moved"))
+    warm = [p for p, _ in sketch_stream.iter_path_sketches(
+        [paths[2], paths[0], paths[4]], store)]
+    assert warm == [paths[2], paths[0], paths[4]]
+
+
+def test_streamed_pair_pass_matches_staged(tmp_path, monkeypatch):
+    """The overlapped streamed pair pass produces the same pair dict
+    as the historical staged path (sketch everything, then
+    threshold_pairs) on a two-family workload."""
+    from galah_tpu.backends.minhash_backend import MinHashPreclusterer
+    from galah_tpu.io.diskcache import CacheDir
+
+    rng = np.random.default_rng(14)
+    base = rng.choice(list("ACGT"), size=6000)
+    paths = []
+    for i in range(6):
+        seq = base.copy()
+        if i >= 3:  # second family
+            sites = rng.random(seq.shape[0]) < 0.03
+            seq[sites] = rng.choice(list("ACGT"), size=int(sites.sum()))
+        paths.append(_write_fasta(tmp_path, f"m{i}.fna",
+                                  ">c\n" + "".join(seq) + "\n"))
+
+    monkeypatch.delenv("GALAH_TPU_SKETCH_STRATEGY", raising=False)
+    streamed = MinHashPreclusterer(
+        0.95, sketch_size=64,
+        cache=CacheDir(str(tmp_path / "c1"))).distances(paths)
+    # a "c" pin routes the backend down the historical staged path
+    monkeypatch.setenv("GALAH_TPU_SKETCH_STRATEGY",
+                       "c" if sketch_stream._c_sketcher_available()
+                       else "xla")
+    sp = MinHashPreclusterer(
+        0.95, sketch_size=64, cache=CacheDir(str(tmp_path / "c2")))
+    monkeypatch.setattr(sp, "_streamed_pair_pass",
+                        lambda _paths: None)
+    staged = sp.distances(paths)
+    assert dict(streamed.items()) == dict(staged.items())
+    assert len(dict(staged.items())) >= 3  # both families pair up
+
+
+def test_threshold_pairs_streamed_unit():
+    """threshold_pairs_streamed over row blocks == threshold_pairs
+    over the full matrix, including sentinel-padded and empty rows,
+    at a block size that does not divide n."""
+    from galah_tpu.ops.constants import SENTINEL
+    from galah_tpu.ops.pairwise import (
+        threshold_pairs,
+        threshold_pairs_streamed,
+    )
+
+    rng = np.random.default_rng(15)
+    n, ss = 70, 64
+    pool = rng.integers(0, 1 << 63, size=200, dtype=np.uint64)
+    mat = np.empty((n, ss), dtype=np.uint64)
+    for i in range(n):
+        mat[i] = np.sort(rng.choice(pool, size=ss, replace=False))
+    mat[7, :] = np.uint64(SENTINEL)              # empty sketch
+    mat[9, 10:] = np.uint64(SENTINEL)            # sentinel-padded row
+    mat[9, :10] = np.sort(mat[9, :10])
+
+    want = threshold_pairs(mat, k=21, min_ani=0.75, sketch_size=ss)
+
+    def blocks(b):
+        for r0 in range(0, n, b):
+            yield r0, mat[r0:r0 + b]
+
+    for b in (32, 37):
+        got = threshold_pairs_streamed(
+            blocks(b), n, k=21, min_ani=0.75, sketch_size=ss, block=b)
+        assert got == want
+    assert want  # the pool overlap produces real pairs
+
+
+def test_backpressure_bounded_under_slow_io(tmp_path, monkeypatch):
+    """With a slow-io fault at the io.ingest site and a slow consumer,
+    the stream still completes, the injector fires, and the number of
+    parsed genomes in flight never exceeds the depth bound — memory
+    stays O(depth + workers), not O(corpus)."""
+    import threading
+    import time
+
+    from galah_tpu.io import fasta
+    from galah_tpu.resilience import faults
+
+    rng = np.random.default_rng(16)
+    paths = [_write_fasta(tmp_path, f"b{i}.fna",
+                          f">c\n{_rand_seq(rng, 300)}\n")
+             for i in range(12)]
+    lock = threading.Lock()
+    state = {"loaded": 0, "consumed": 0, "max_ahead": 0}
+    real_read = fasta.read_genome
+
+    def counting_read(path, *a, **kw):
+        with lock:
+            state["loaded"] += 1
+            ahead = state["loaded"] - state["consumed"]
+            state["max_ahead"] = max(state["max_ahead"], ahead)
+        return real_read(path, *a, **kw)
+
+    monkeypatch.setattr(fasta, "read_genome", counting_read)
+    monkeypatch.setenv("GALAH_TPU_INGEST_DEPTH", "2")
+    injector = faults.FaultInjector(faults.parse_spec(
+        "site=io.ingest;kind=slow-io;prob=1.0;hang=0.01;max=4"))
+    faults.install(injector)
+    try:
+        store = _fresh_store(tmp_path, "cache_bp")
+        for _p, _s in sketch_stream.iter_path_sketches(
+                paths, store,
+                strategy="c" if sketch_stream._c_sketcher_available()
+                else "xla"):
+            time.sleep(0.005)  # slow consumer: forces backpressure
+            with lock:
+                state["consumed"] += 1
+    finally:
+        faults.reset()
+    assert state["loaded"] == 12
+    assert injector.fired() == 4
+    # depth=2 look-ahead + the one being consumed + one in handoff
+    assert state["max_ahead"] <= 5
+
+
+def test_corrupt_gzip_error_names_path(tmp_path):
+    """A corrupt .gz propagates as BadGenomeError carrying the path —
+    through read_genome and through the streaming pipeline."""
+    from galah_tpu.io.fasta import BadGenomeError
+
+    bad = tmp_path / "bad.fna.gz"
+    bad.write_bytes(b"\x1f\x8b\x08\x00garbage-not-a-gzip-stream")
+    with pytest.raises(BadGenomeError) as ei:
+        read_genome(str(bad))
+    assert str(bad) in str(ei.value)
+    assert ei.value.reason == "corrupt"
+
+    store = _fresh_store(tmp_path, "cache_corrupt")
+    with pytest.raises(BadGenomeError, match="corrupt"):
+        list(sketch_stream.iter_path_sketches([str(bad)], store))
+
+
+def test_c_fallback_observability(tmp_path, monkeypatch):
+    """When the C ingest fast path is unavailable, the numpy fallback
+    is visible: a warn_once, an ingest-c-fallback event, and the
+    ingest.c_fallback counter — never a silent 10x slowdown."""
+    from galah_tpu.io import fasta
+    from galah_tpu.obs import events
+    from galah_tpu.obs import metrics as obs_metrics
+
+    p = _write_fasta(tmp_path, "cf.fna", ">c\nACGTACGTACGT\n")
+    monkeypatch.setattr(fasta, "_get_cingest", lambda: None)
+    monkeypatch.setattr(fasta, "_CINGEST_ERR",
+                        [RuntimeError("no compiler")])
+    before = obs_metrics.snapshot().get(
+        "ingest.c_fallback", {}).get("value", 0)
+    g = read_genome(p)
+    assert g.length == 12
+    after = obs_metrics.snapshot()["ingest.c_fallback"]["value"]
+    assert after >= before + 1
+    evs = [e for e in events.snapshot()
+           if e["kind"] == "ingest-c-fallback"
+           and e["what"] == "build/load failed"]
+    assert evs and "no compiler" in evs[-1]["error"]
