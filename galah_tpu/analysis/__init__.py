@@ -17,6 +17,11 @@ Checker families
          and durable-write discipline (GL806: durable artifacts are
          written only through io/atomic.py)
   GL9xx  numeric determinism (DETERMINISM_CONTRACT annotations)
+  GL10xx pipeline discipline (streamed stages must stay streamed:
+         materialized iterators, host sync in streaming stages,
+         unbounded queues/pools, missing occupancy-gauge emission);
+         the runtime complement is the GalahSan sanitizer
+         (galah_tpu/analysis/sanitizer.py, GALAH_SAN=1)
 
 Suppression: ``# galah-lint: ignore[GL103]`` on the flagged line or
 the line above (optionally ``... expires=YYYY-MM-DD``; past the date
@@ -38,7 +43,7 @@ from galah_tpu.analysis import core
 from galah_tpu.analysis.core import Finding, Severity, SourceFile
 
 CHECK_NAMES = ("pallas", "runtime", "flags", "markers", "shapes",
-               "obs", "concurrency", "fs", "determinism",
+               "obs", "concurrency", "fs", "determinism", "pipeline",
                "suppressions")
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
                                 "baseline.json")
@@ -101,6 +106,11 @@ def run_checks(sources: Dict[str, SourceFile],
             check_determinism_file
         for src in sources.values():
             findings.extend(check_determinism_file(src))
+    if "pipeline" in checks:
+        from galah_tpu.analysis.pipeline_check import \
+            check_pipeline_file
+        for src in sources.values():
+            findings.extend(check_pipeline_file(src))
     if "suppressions" in checks:
         for src in sources.values():
             findings.extend(core.check_suppression_expiry(src))
@@ -123,9 +133,15 @@ def run_lint(root: Optional[str] = None,
 def changed_files(root: str) -> Optional[Set[str]]:
     """Repo-relative paths git considers changed (staged + unstaged vs
     HEAD, plus untracked), or None when git can't answer — the caller
-    falls back to a full scan rather than silently linting nothing."""
+    falls back to a full scan rather than silently linting nothing.
+
+    Deleted and renamed-away paths are skipped (``--diff-filter=d``
+    plus an existence check for the rename source in the staged half):
+    they have no content to lint, and feeding vanished files to the
+    checkers used to crash the pre-commit gate mid-rename."""
     paths: Set[str] = set()
-    for cmd in (["git", "diff", "--name-only", "HEAD"],
+    for cmd in (["git", "diff", "--name-only", "--diff-filter=d",
+                 "HEAD"],
                 ["git", "ls-files", "--others", "--exclude-standard"]):
         try:
             proc = subprocess.run(cmd, cwd=root, capture_output=True,
@@ -137,7 +153,10 @@ def changed_files(root: str) -> Optional[Set[str]]:
         paths.update(line.strip().replace("\\", "/")
                      for line in proc.stdout.splitlines()
                      if line.strip())
-    return paths
+    # --diff-filter=d keeps a rename's old path when git reports it as
+    # an unpaired modify; only paths that still exist are lintable.
+    return {p for p in paths
+            if os.path.isfile(os.path.join(root, p))}
 
 
 def add_lint_arguments(parser: argparse.ArgumentParser) -> None:

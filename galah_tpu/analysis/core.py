@@ -355,7 +355,12 @@ def render_human(findings: Sequence[Finding],
 
 
 def family_of(code: str) -> str:
-    """'GL103' -> 'GL1xx' (rule families group by leading digit)."""
+    """'GL103' -> 'GL1xx'; four-digit codes group by their leading two
+    digits ('GL1001' -> 'GL10xx'), so the GL10xx pipeline family does
+    not collide with the GL1xx Pallas family."""
+    if (len(code) == 6 and code[:2] == "GL"
+            and code[2:].isdigit()):
+        return f"GL{code[2:4]}xx"
     if len(code) >= 3 and code[:2] == "GL":
         return f"GL{code[2]}xx"
     return code

@@ -758,6 +758,12 @@ def main(argv=None) -> int:
                          "(%s)", platform, msg)
             return 1
     logger.info("galah-tpu version %s", galah_tpu.__version__)
+    # GALAH_SAN=1 arms the runtime concurrency sanitizer for this run
+    # (the chaos harness and validation script set it); its summary
+    # lands in the run report via obs.report.assemble.
+    from galah_tpu.analysis import sanitizer as galah_san
+
+    galah_san.maybe_install()
     try:
         if args.subcommand == "cluster":
             return run_cluster(args)

@@ -218,6 +218,19 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "the "
               "median so an all-identical history cannot gate on "
               "epsilon)"),
+    Flag("GALAH_SAN", kind="bool", section="observability",
+         help="1 arms GalahSan, the runtime concurrency sanitizer "
+              "(galah_tpu/analysis/sanitizer.py): wraps the threaded "
+              "modules' declared locks, diffs the observed "
+              "acquisition graph against LOCK_ORDER, and checks "
+              "GUARDED_BY mutations for races. Tier-1 pytest and the "
+              "chaos harness set it; the summary lands in "
+              "run_report.json (docs/sanitizer.md)"),
+    Flag("GALAH_SAN_REPORT", section="observability",
+         help="Path for the standalone sanitizer_report.json (full "
+              "lock graph + findings); default sanitizer_report.json "
+              "in the working directory when the sanitizer writes "
+              "one"),
     # -- resilience --------------------------------------------------------
     Flag("GALAH_FI", kind="grammar", section="resilience",
          help="Deterministic fault injection, e.g. "

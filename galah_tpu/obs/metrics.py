@@ -195,6 +195,26 @@ class MetricsRegistry:
 # one-per-process idiom as timing.GLOBAL and dispatch.GLOBAL).
 GLOBAL = MetricsRegistry()
 
+#: The one pipeline-occupancy gauge name. Registered centrally so the
+#: GL1004 auditor, the streamed stages that feed it, and the dataflow
+#: work all agree on a single metric: fraction of a streaming stage's
+#: wall spent with the consumer busy (1.0 = never starved, the
+#: ROADMAP's "no stage starves" proof).
+PIPELINE_OCCUPANCY_GAUGE = "workload.pipeline_occupancy"
+
+
+def pipeline_occupancy(value: float, stage: str = "") -> Gauge:
+    """Set the occupancy gauge (per-stage variant via ``[stage]``,
+    like the timing counters' ``retries[site]`` convention)."""
+    name = (f"{PIPELINE_OCCUPANCY_GAUGE}[{stage}]" if stage
+            else PIPELINE_OCCUPANCY_GAUGE)
+    g = GLOBAL.gauge(
+        name,
+        help="Streaming-stage occupancy: fraction of stage wall with "
+             "the consumer busy (1.0 = never starved)")
+    g.set(max(0.0, min(1.0, float(value))))
+    return g
+
 
 def counter(name: str, help: str = "", unit: str = "") -> Counter:
     return GLOBAL.counter(name, help=help, unit=unit)

@@ -26,7 +26,7 @@ logger = logging.getLogger(__name__)
 
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
                            "run_report.schema.json")
-REPORT_VERSION = 3
+REPORT_VERSION = 4
 
 # disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
 _DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
@@ -180,6 +180,14 @@ def assemble(subcommand: str,
         report["device_costs"] = obs_profile.snapshot()
     except Exception:  # device costs are additive; never lose a report
         logger.debug("device-cost snapshot failed", exc_info=True)
+    try:
+        from galah_tpu.analysis import sanitizer as galah_san
+
+        san_summary = galah_san.summary_if_enabled()
+        if san_summary is not None:
+            report["sanitizer"] = san_summary
+    except Exception:  # additive section (v4); never lose a report
+        logger.debug("sanitizer summary failed", exc_info=True)
     if lint is not None:
         report["lint"] = lint
     return report
@@ -326,6 +334,23 @@ def render(report: dict) -> str:
             if util is not None:
                 parts.append(f"mxu={100.0 * util:.2f}%")
             lines.append(f"  {name}: " + " ".join(parts))
+    san = report.get("sanitizer")
+    if san is not None:
+        lines += [
+            "",
+            "concurrency sanitizer (GalahSan):",
+            f"  {san.get('acquisitions', 0)} acquisitions across "
+            f"{san.get('locks', 0)} locks in "
+            f"{san.get('modules', 0)} modules",
+            f"  edges: {san.get('edges_observed', 0)} observed / "
+            f"{san.get('edges_declared', 0)} declared "
+            f"({san.get('unexercised', 0)} declared-but-unexercised)",
+            f"  violations: "
+            f"{san.get('undeclared_acquisitions', 0)} undeclared, "
+            f"{san.get('undeclared_edges', 0)} unordered, "
+            f"{san.get('inversions', 0)} inversions, "
+            f"{san.get('races', 0)} races",
+        ]
     lint = report.get("lint")
     if lint is not None:
         fams = ", ".join(f"{fam}={n}" for fam, n in
@@ -449,6 +474,18 @@ def diff(a: dict, b: dict, label_a: str = "A",
                          else f" ({vb - va:+.6g})")
                 lines.append(
                     f"  {name}.{field}: {va} -> {vb}{delta}")
+
+    # sanitizer drift — additive v4 section, .get throughout
+    na, nb = a.get("sanitizer"), b.get("sanitizer")
+    if na is not None or nb is not None:
+        na, nb = na or {}, nb or {}
+        lines += ["", "sanitizer drift:"]
+        for key in ("acquisitions", "edges_observed",
+                    "edges_declared", "undeclared_acquisitions",
+                    "undeclared_edges", "inversions", "races",
+                    "unexercised"):
+            va, vb = int(na.get(key, 0)), int(nb.get(key, 0))
+            lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
 
     la, lb = a.get("lint"), b.get("lint")
     if la is not None or lb is not None:

@@ -110,6 +110,15 @@ GUARDED_BY = {
 }
 LOCK_ORDER = ["_DEMOTE_LOCK"]
 
+# Pipeline contract, machine-checked by `galah-tpu lint` (GL10xx):
+# these stages are generators that must stay streamed (GL1001/GL1002),
+# and this module feeds the occupancy gauge that proves the overlap
+# (GL1004; the ROADMAP's "no stage starves" target).
+PIPELINE_STAGE = {
+    "streaming": ["iter_path_sketches", "iter_sketch_row_blocks"],
+    "occupancy_gauge": "workload.pipeline_occupancy",
+}
+
 _DEMOTE_LOCK = threading.Lock()
 _DEMOTED = False
 
@@ -513,10 +522,15 @@ def iter_path_sketches(
     # Misses stream back in submission order == path order restricted
     # to misses, so a single merge walk yields every unique path in
     # original order — the property the overlapped pair pass needs.
+    wait_s = 0.0
     for p in dict.fromkeys(paths):
         s = hits.get(p)
         if s is None:
+            tw = time.monotonic()
             cp, s = next(computed)
+            # time blocked on the producer = consumer starvation; the
+            # complement is the occupancy the overlap is meant to buy
+            wait_s += time.monotonic() - tw
             assert cp == p, f"sketch stream out of order: {cp} != {p}"
             s = store.insert(p, s)
         yield p, s
@@ -533,6 +547,7 @@ def iter_path_sketches(
             "workload.ingest_mbp_s",
             help="end-to-end ingest+sketch throughput of the streaming "
                  "sketch stage", unit="Mbp/s").set(bp_total / 1e6 / wall)
+        obs_metrics.pipeline_occupancy(1.0 - wait_s / wall)
 
 
 def iter_sketch_row_blocks(

@@ -110,6 +110,9 @@ def launch(argv: List[str], extra_env: Optional[Dict[str, str]] = None
     env = dict(os.environ)
     env.pop("GALAH_FI", None)  # each run decides its own faults
     env.setdefault("JAX_PLATFORMS", "cpu")
+    # chaos runs double as the concurrency-sanitizer workload: every
+    # child arms GalahSan so kills land mid-acquisition too
+    env.setdefault("GALAH_SAN", "1")
     env.update(extra_env or {})
     return subprocess.Popen(argv, env=env,
                             stdout=subprocess.PIPE,
@@ -259,6 +262,12 @@ def check_report(report_path: str, ckpt: str, was_preempted: bool
     if was_preempted and pre.get("prior_interruptions", 0) < 1:
         return ("cooperative preemption left no interruption record "
                 f"(prior_interruptions={pre.get('prior_interruptions')})")
+    san = rep.get("sanitizer")
+    if isinstance(san, dict):
+        for key in ("undeclared_acquisitions", "undeclared_edges",
+                    "inversions", "races"):
+            if san.get(key, 0):
+                return f"sanitizer violation: {key}={san[key]}"
     return None
 
 

@@ -8,7 +8,18 @@
 #
 # Install as a git hook:   ln -s ../../scripts/lint_gate.sh .git/hooks/pre-commit
 # Run by hand:             scripts/lint_gate.sh [--json] [extra lint args]
+#
+# --san: instead of the static lint, run the bounded GalahSan smoke —
+# the sanitizer reproducer suite plus the obs tests (the most
+# lock-heavy tier-1 subset) under GALAH_SAN=1. Exit 1 on any
+# violation; the gate test in tests/test_sanitizer.py enforces zero.
 set -u
 cd "$(dirname "$0")/.." || exit 1
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+if [ "${1:-}" = "--san" ]; then
+    shift
+    export GALAH_SAN=1
+    exec python -m pytest tests/test_sanitizer.py tests/test_obs.py \
+        -q -m 'not slow' -p no:cacheprovider "$@"
+fi
 exec python -m galah_tpu.analysis --changed-only "$@"

@@ -128,6 +128,12 @@ BENCH_TIMEOUT=3000
 # registry, shape snapshots — seconds on the host VM, and a failure
 # here means the expensive hardware stages would exercise broken code.
 run_stage lint 300 python -u -m galah_tpu.analysis --json
+# GalahSan smoke on the host CPU: the sanitizer reproducer suite plus
+# the lock-heavy obs tests under GALAH_SAN=1 (docs/sanitizer.md). A
+# lock-order or GUARDED_BY violation fails here in seconds rather than
+# as a flaky hang deep inside a hardware stage.
+run_stage san_smoke 600 env JAX_PLATFORMS=cpu \
+  bash scripts/lint_gate.sh --san
 # Kill-anywhere chaos smoke on the host CPU (no tunnel use): seeded
 # interrupted-then-resumed cluster runs must produce byte-identical
 # results with zero corrupt artifacts (docs/resilience.md). Runs early

@@ -26,6 +26,18 @@ import pytest
 
 REFERENCE_DATA = pathlib.Path("/root/reference/tests/data")
 
+# Tier-1 runs under the GalahSan runtime concurrency sanitizer
+# (docs/sanitizer.md): the threaded modules' declared locks are
+# wrapped so the observed acquisition graph and GUARDED_BY mutations
+# are validated under the real workload. GALAH_SAN=0 opts a run out
+# (e.g. when bisecting a failure the instrumentation might mask).
+# galah-lint: ignore[GL402] tier-1 opts in; the registry default (unset) is for production runs
+os.environ.setdefault("GALAH_SAN", "1")
+
+from galah_tpu.analysis import sanitizer as _galah_san  # noqa: E402
+
+_galah_san.maybe_install()
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -68,6 +80,24 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords or "hardware" in item.keywords:
             item.add_marker(skip)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """One GalahSan line per session: the observed-graph totals and
+    the must-be-zero violation counts. tests/test_sanitizer.py's gate
+    test is what FAILS the run on violations; this line is where a
+    human sees the numbers."""
+    if not _galah_san.GLOBAL.installed:
+        return
+    s = _galah_san.GLOBAL.summary()
+    terminalreporter.write_line(
+        f"galah-san: {s['acquisitions']} acquisitions / "
+        f"{s['locks']} locks, edges {s['edges_observed']} observed / "
+        f"{s['edges_declared']} declared "
+        f"({s['unexercised']} unexercised); violations: "
+        f"{s['undeclared_acquisitions']} undeclared, "
+        f"{s['undeclared_edges']} unordered, "
+        f"{s['inversions']} inversions, {s['races']} races")
 
 
 @pytest.fixture(scope="session")

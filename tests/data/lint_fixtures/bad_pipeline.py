@@ -1,0 +1,46 @@
+"""GL10xx fixture: every pipeline-discipline violation in one file."""
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+
+# GUARDED_BY puts this module in GL1003's threaded scope.
+GUARDED_BY = {"_RESULTS": "_LOCK"}
+
+# GL1005 x2: unknown key "depth"; "missing_stage" is not defined here.
+# GL1004: the declared gauge is never emitted anywhere in the file.
+PIPELINE_STAGE = {
+    "streaming": ["iter_rows", "missing_stage"],
+    "occupancy_gauge": "workload.pipeline_occupancy",
+    "depth": 4,
+}
+
+_LOCK = threading.Lock()
+_RESULTS = {}
+
+
+def iter_rows(paths):
+    for p in paths:
+        x = compute(p)
+        jax.block_until_ready(x)  # GL1002 (host sync in streaming stage)
+        yield x
+
+
+def compute(p):
+    return p
+
+
+def drain_everything(paths):
+    rows = list(iter_rows(paths))       # GL1001 (direct materialization)
+    stream = iter_rows(paths)
+    ordered = sorted(stream)            # GL1001 (via name binding)
+    return rows, ordered
+
+
+def build_handoffs():
+    q = queue.Queue()                   # GL1003 (no maxsize)
+    sq = queue.SimpleQueue()            # GL1003 (cannot be bounded)
+    pool = ThreadPoolExecutor()         # GL1003 (no max_workers)
+    return q, sq, pool

@@ -542,7 +542,7 @@ def test_lint_run_report_carries_summary(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(report_path.read_text())
-    assert report["version"] == 3
+    assert report["version"] == 4
     assert report["run"]["subcommand"] == "lint"
     assert set(report["lint"]) == {"errors", "warnings", "notes",
                                    "suppressed", "by_family"}
@@ -581,6 +581,14 @@ def test_changed_files_tracks_git_state(tmp_path):
     (tmp_path / "tracked.py").write_text("x = 2\n")
     (tmp_path / "untracked.py").write_text("y = 1\n")
     assert changed_files(root) == {"tracked.py", "untracked.py"}
+    subprocess.run(git + ["add", "-A"], check=True)
+    subprocess.run(git + ["commit", "-q", "-m", "more"], check=True)
+    subprocess.run(git + ["mv", "tracked.py", "renamed.py"],
+                   check=True)
+    subprocess.run(git + ["rm", "-q", "untracked.py"], check=True)
+    # vanished paths (rename source, deletion) must be skipped —
+    # feeding them to the checkers used to crash the pre-commit gate
+    assert changed_files(root) == {"renamed.py"}
 
 
 # -- GL806: durable-write discipline (fs_check) -----------------------
@@ -625,4 +633,113 @@ def test_gl806_suppression_applies():
 
 def test_repo_durable_modules_all_write_through_atomic():
     found = [f for f in run_lint(checks=("fs",)) if not f.suppressed]
+    assert not found, [(f.path, f.line, f.message) for f in found]
+
+
+# ---------------------------------------------------------------------------
+# GL10xx: pipeline discipline
+# ---------------------------------------------------------------------------
+
+
+def test_bad_pipeline_fires_every_rule():
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    src = load_fixture("bad_pipeline.py",
+                       path="galah_tpu/ops/bad_pipeline.py")
+    found = check_pipeline_file(src)
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, []).append(f.line)
+    # direct list(iter_rows(...)) + sorted() over a bound stream
+    assert sorted(by_code["GL1001"]) == [36, 38]
+    # block_until_ready inside the declared streaming stage
+    assert by_code["GL1002"] == [27]
+    # Queue() no maxsize, SimpleQueue(), ThreadPoolExecutor() bare
+    assert sorted(by_code["GL1003"]) == [43, 44, 45]
+    # declared gauge never emitted (anchored at the annotation)
+    assert by_code["GL1004"] == [14]
+    # unknown key "depth" + dangling streaming name "missing_stage"
+    assert sorted(by_code["GL1005"]) == [14, 14]
+    assert sorted(by_code) == ["GL1001", "GL1002", "GL1003",
+                               "GL1004", "GL1005"]
+    assert all(f.severity is Severity.WARNING for f in found)
+
+
+def test_clean_pipeline_is_silent():
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    src = load_fixture("clean_pipeline.py",
+                       path="galah_tpu/ops/clean_pipeline.py")
+    assert check_pipeline_file(src) == []
+
+
+def test_gl1001_scope_excludes_utils_obs_analysis():
+    from galah_tpu.analysis.pipeline_check import (check_pipeline_file,
+                                                   in_scope)
+
+    for path in ("galah_tpu/utils/timing.py", "galah_tpu/obs/report.py",
+                 "galah_tpu/analysis/core.py", "tests/test_x.py",
+                 "scripts/bench.py"):
+        assert not in_scope(path)
+    assert in_scope("galah_tpu/ops/sketch_stream.py")
+    # out of GL1001 scope, the other families still apply
+    src = load_fixture("bad_pipeline.py", path="tests/bad_pipeline.py")
+    found = check_pipeline_file(src)
+    assert "GL1001" not in codes(found)
+    assert {"GL1002", "GL1003", "GL1004", "GL1005"} <= set(codes(found))
+
+
+def test_gl1003_only_fires_in_threaded_modules():
+    import ast
+
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    text = ("import queue\n"
+            "q = queue.Queue()\n")
+    src = SourceFile(path="galah_tpu/ops/x.py", text=text,
+                     tree=ast.parse(text))
+    assert check_pipeline_file(src) == []  # no lock annotations
+    text_threaded = "GUARDED_BY = {}\nLOCK_ORDER = []\n" + text
+    src = SourceFile(path="galah_tpu/ops/x.py", text=text_threaded,
+                     tree=ast.parse(text_threaded))
+    assert codes(check_pipeline_file(src)) == ["GL1003"]
+
+
+def test_gl1004_accepts_constant_literal_and_helper_emission():
+    import ast
+
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    head = ('PIPELINE_STAGE = {"streaming": ["iter_x"],\n'
+            '    "occupancy_gauge": "workload.pipeline_occupancy"}\n'
+            "def iter_x():\n    yield 1\n")
+    for emit in ('m.gauge("workload.pipeline_occupancy").set(1)\n',
+                 "m.gauge(metrics.PIPELINE_OCCUPANCY_GAUGE).set(1)\n",
+                 "metrics.pipeline_occupancy(0.5)\n"):
+        text = head + f"def done():\n    {emit}"
+        src = SourceFile(path="galah_tpu/ops/x.py", text=text,
+                         tree=ast.parse(text))
+        assert "GL1004" not in codes(check_pipeline_file(src)), emit
+    src = SourceFile(path="galah_tpu/ops/x.py", text=head,
+                     tree=ast.parse(head))
+    assert codes(check_pipeline_file(src)) == ["GL1004"]
+
+
+def test_gl10xx_family_and_suppression():
+    from galah_tpu.analysis.core import family_of
+
+    assert family_of("GL1001") == "GL10xx"
+    assert family_of("GL101") == "GL1xx"  # no collision with Pallas
+    src = load_fixture("bad_pipeline.py",
+                       path="galah_tpu/ops/bad_pipeline.py")
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    found = check_pipeline_file(src)
+    core.apply_suppressions(found, {src.path: src}, {})
+    assert all(not f.suppressed for f in found)  # fixture carries none
+
+
+def test_repo_pipeline_discipline_holds():
+    found = [f for f in run_lint(checks=("pipeline",))
+             if not f.suppressed]
     assert not found, [(f.path, f.line, f.message) for f in found]
