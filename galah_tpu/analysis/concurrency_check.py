@@ -69,6 +69,8 @@ THREADED_MODULES = (
     "galah_tpu/resilience/faults.py",
     "galah_tpu/utils/timing.py",
     "galah_tpu/ops/sketch_stream.py",
+    "galah_tpu/index/store.py",
+    "galah_tpu/index/incremental.py",
 )
 
 #: Method calls that mutate their receiver in place.

@@ -43,6 +43,8 @@ DURABLE_MODULES = (
     "galah_tpu/obs/report.py",
     "galah_tpu/obs/ledger.py",
     "galah_tpu/resilience/quarantine.py",
+    "galah_tpu/index/store.py",
+    "galah_tpu/index/incremental.py",
 )
 
 #: The one sanctioned writer.

@@ -28,7 +28,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import logging
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from galah_tpu.config import (
     CLUSTER_METHODS,
@@ -215,6 +215,79 @@ def _get(values: Dict, definition: ClustererCommandDefinition,
     return values.get(definition.dest(flag_name))
 
 
+def quality_order_genomes(
+    genome_paths: Sequence[str],
+    values: Dict,
+    definition: ClustererCommandDefinition = ClustererCommandDefinition(),
+    threads: int = 1,
+    missing_key: str = "checkm-input-missing",
+    missing_msg: str = ("Since CheckM input is missing, genomes are not "
+                        "being ordered by quality. Instead the order of "
+                        "their input is being used"),
+) -> Tuple[List[str], bool]:
+    """Quality-filter + order `genome_paths` from `values`' inputs.
+
+    Returns (ordered_paths, used_quality). When no quality input was
+    given the paths come back in input order, `used_quality` is False,
+    and `missing_msg` is warned once under `missing_key` — `galah-tpu
+    index` passes its own key/message so the unranked-insert fallback
+    stays a distinct, countable signal (satellite of the index PR).
+    Raises ValueError on conflicting quality inputs, like the
+    reference's factory.
+    """
+    from galah_tpu import quality as quality_mod
+
+    d = definition
+    quality_inputs = [
+        ("checkm_tab_table", _get(values, d, d.checkm_tab_table)),
+        ("checkm2_quality_report",
+         _get(values, d, d.checkm2_quality_report)),
+        ("genome_info", _get(values, d, d.genome_info)),
+    ]
+    given = [(k, v) for k, v in quality_inputs if v]
+    if len(given) > 1:
+        raise ValueError(
+            "Specify at most one of --checkm-tab-table, "
+            "--checkm2-quality-report and --genome-info")
+    if not given:
+        from galah_tpu.obs.events import warn_once
+
+        # Repeated construction (bench rungs, embedding tools) must not
+        # repeat this once-per-run fact — BENCH_r05's tail carried one
+        # copy per in-process bench stage. The explicit key dedupes
+        # across every module that might phrase the same fact.
+        warn_once(logger, missing_msg, key=missing_key)
+        return list(genome_paths), False
+    kind, path = given[0]
+    formula = _get(values, d, d.quality_formula) \
+        or Defaults.QUALITY_FORMULA
+    if kind == "checkm_tab_table":
+        logger.info("Reading CheckM tab table ..")
+        table = quality_mod.read_checkm1_tab_table(path)
+    elif kind == "checkm2_quality_report":
+        logger.info("Reading CheckM2 Quality report ..")
+        table = quality_mod.read_checkm2_quality_report(path)
+    else:
+        if formula == "dRep":
+            raise ValueError(
+                "The dRep quality formula cannot be used with "
+                "--genome-info")
+        table = quality_mod.read_genome_info_file(path)
+    min_comp = _get(values, d, d.min_completeness)
+    max_cont = _get(values, d, d.max_contamination)
+    ordered = quality_mod.filter_and_order_genomes(
+        list(genome_paths), table, formula=formula,
+        min_completeness=(parse_percentage(
+            min_comp, f"--{d.min_completeness}")
+            if min_comp is not None else None),
+        max_contamination=(parse_percentage(
+            max_cont, f"--{d.max_contamination}")
+            if max_cont is not None else None),
+        threads=threads,
+    )
+    return ordered, True
+
+
 def generate_galah_clusterer(
     genome_paths: Sequence[str],
     values: Dict,
@@ -229,7 +302,6 @@ def generate_galah_clusterer(
     cluster_argument_parsing.rs:897-1158). Raises ValueError on
     conflicting quality inputs, like the reference's factory.
     """
-    from galah_tpu import quality as quality_mod
     from galah_tpu.backends import (
         FastANIEquivalentClusterer,
         HLLPreclusterer,
@@ -302,58 +374,11 @@ def generate_galah_clusterer(
                 "every input genome was quarantined as unreadable; "
                 "nothing to cluster (see the quarantine manifest)")
 
-    # Quality filter + ordering
-    quality_inputs = [
-        ("checkm_tab_table", _get(values, d, d.checkm_tab_table)),
-        ("checkm2_quality_report",
-         _get(values, d, d.checkm2_quality_report)),
-        ("genome_info", _get(values, d, d.genome_info)),
-    ]
-    given = [(k, v) for k, v in quality_inputs if v]
-    if len(given) > 1:
-        raise ValueError(
-            "Specify at most one of --checkm-tab-table, "
-            "--checkm2-quality-report and --genome-info")
-    if not given:
-        from galah_tpu.obs.events import warn_once
-
-        # Repeated construction (bench rungs, embedding tools) must not
-        # repeat this once-per-run fact — BENCH_r05's tail carried one
-        # copy per in-process bench stage. The explicit key dedupes
-        # across every module that might phrase the same fact.
-        warn_once(
-            logger,
-            "Since CheckM input is missing, genomes are not being ordered "
-            "by quality. Instead the order of their input is being used",
-            key="checkm-input-missing")
-    else:
-        kind, path = given[0]
-        formula = _get(values, d, d.quality_formula) \
-            or Defaults.QUALITY_FORMULA
-        if kind == "checkm_tab_table":
-            logger.info("Reading CheckM tab table ..")
-            table = quality_mod.read_checkm1_tab_table(path)
-        elif kind == "checkm2_quality_report":
-            logger.info("Reading CheckM2 Quality report ..")
-            table = quality_mod.read_checkm2_quality_report(path)
-        else:
-            if formula == "dRep":
-                raise ValueError(
-                    "The dRep quality formula cannot be used with "
-                    "--genome-info")
-            table = quality_mod.read_genome_info_file(path)
-        min_comp = _get(values, d, d.min_completeness)
-        max_cont = _get(values, d, d.max_contamination)
-        genome_paths = quality_mod.filter_and_order_genomes(
-            genome_paths, table, formula=formula,
-            min_completeness=(parse_percentage(
-                min_comp, f"--{d.min_completeness}")
-                if min_comp is not None else None),
-            max_contamination=(parse_percentage(
-                max_cont, f"--{d.max_contamination}")
-                if max_cont is not None else None),
-            threads=threads,
-        )
+    # Quality filter + ordering (shared with `galah-tpu index`, which
+    # passes its own missing-input warning so unranked incremental
+    # inserts are observable as a distinct event)
+    genome_paths, _used_quality = quality_order_genomes(
+        genome_paths, values, definition=d, threads=threads)
 
     # skani+skani: precluster at the final threshold (reference:
     # src/cluster_argument_parsing.rs:983-1030)

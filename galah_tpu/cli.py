@@ -18,7 +18,7 @@ import time
 import galah_tpu
 from galah_tpu.api import add_cluster_arguments, generate_galah_clusterer
 from galah_tpu.config import (Defaults, HASH_ALGORITHMS,
-                              parse_percentage)
+                              QUALITY_FORMULAS, parse_percentage)
 from galah_tpu.utils import timing
 from galah_tpu.utils.logging import set_log_level
 
@@ -54,6 +54,30 @@ def _add_genome_inputs(p: argparse.ArgumentParser) -> None:
     p.add_argument("-x", "--genome-fasta-extension", default="fna",
                    help="File extension of genomes in the directory "
                         "(default: fna)")
+
+
+def _add_index_quality(p: argparse.ArgumentParser) -> None:
+    """Quality-ordering inputs for `index build`/`index insert` — the
+    same surface `cluster` carries, because insert order IS the greedy
+    quality order the persisted decisions are sound under."""
+    p.add_argument("--checkm-tab-table",
+                   help="Output of `checkm qa .. --tab_table`")
+    p.add_argument("--checkm2-quality-report",
+                   help="CheckM2 quality_report.tsv output")
+    p.add_argument("--genome-info",
+                   help="dRep-style genome info CSV "
+                        "(genome,completeness,contamination)")
+    p.add_argument("--quality-formula",
+                   default=Defaults.QUALITY_FORMULA,
+                   choices=QUALITY_FORMULAS,
+                   help="Quality formula for ranking genomes "
+                        "(default: Parks2020_reduced)")
+    p.add_argument("--min-completeness", type=float,
+                   help="Ignore genomes with less completeness than "
+                        "this percentage")
+    p.add_argument("--max-contamination", type=float,
+                   help="Ignore genomes with more contamination than "
+                        "this percentage")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -261,9 +285,99 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Report regressions but exit 0 — the CI "
                           "mode while a key is still accumulating "
                           "trustworthy history")
+    ix = sub.add_parser(
+        "index",
+        help="Build and incrementally maintain a persistent versioned "
+             "sketch index (insert/query/remove without re-clustering)",
+        description="Persistent versioned sketch index over a "
+                    "dereplicated corpus: `build` clusters once and "
+                    "persists the sketches, thresholded pairs, and "
+                    "greedy decisions; `insert` adds new genomes "
+                    "sketching only them and commits a new generation; "
+                    "`query` answers which cluster a genome would join "
+                    "without mutating anything; `remove` tombstones a "
+                    "genome and locally re-elects; `fsck` audits the "
+                    "on-disk state (docs/index.md)")
+    _add_verbosity(ix)
+    ix.add_argument("--index-dir",
+                    help="Index directory (also via "
+                         "GALAH_TPU_INDEX_DIR); created by `build`, "
+                         "required by every action")
+    ix.add_argument("--trace-events",
+                    help="Write a Chrome-trace-format event timeline "
+                         "to this file. Env equivalent: "
+                         "GALAH_OBS_TRACE_EVENTS")
+    ix.add_argument("--run-report",
+                    help="Write run_report.json (with its `index` "
+                         "section) to this file at run end. Env "
+                         "equivalent: GALAH_OBS_REPORT")
+    ixsub = ix.add_subparsers(dest="index_action")
+    ixb = ixsub.add_parser(
+        "build",
+        help="Dereplicate a corpus once and persist it as generation 1")
+    _add_genome_inputs(ixb)
+    _add_index_quality(ixb)
+    ixb.add_argument("--ani", type=float, default=Defaults.ANI,
+                     help="ANI clustering threshold the index is bound "
+                          "to (default: 95)")
+    ixb.add_argument("--precluster-ani", type=float,
+                     default=Defaults.PRETHRESHOLD_ANI,
+                     help="Sketch-ANI floor for persisted pairs "
+                          "(default: 90)")
+    ixb.add_argument("--hash-algorithm", default=Defaults.HASH_ALGO,
+                     choices=HASH_ALGORITHMS,
+                     help="Sketch hash the index is bound to "
+                          "(default: murmur3)")
+    ixb.add_argument("--sketch-cache",
+                     help="Directory for the persistent sketch cache "
+                          "(also via GALAH_TPU_CACHE); index records "
+                          "share its content-hash keys")
+    ixb.add_argument("--threads", "-t", type=int, default=1)
+    ixi = ixsub.add_parser(
+        "insert",
+        help="Insert new genomes, sketching only them, and commit the "
+             "next generation")
+    _add_genome_inputs(ixi)
+    _add_index_quality(ixi)
+    ixi.add_argument("--sketch-cache",
+                     help="Directory for the persistent sketch cache "
+                          "(also via GALAH_TPU_CACHE)")
+    ixi.add_argument("--threads", "-t", type=int, default=1)
+    ixi.add_argument("--batch", type=int, default=None,
+                     help="Genomes per durable append batch — the "
+                          "preemption safe-boundary granularity "
+                          "(default: GALAH_TPU_INDEX_BATCH)")
+    ixi.add_argument("--resume", action="store_true",
+                     help="Continue an interrupted insert: uncommitted "
+                          "appends past the last committed generation "
+                          "are truncated and the insert redone, "
+                          "converging to the same bytes as an "
+                          "uninterrupted run. (A matching index "
+                          "auto-resumes anyway; --resume records the "
+                          "chain in the run report)")
+    ixq = ixsub.add_parser(
+        "query",
+        help="Answer which cluster each genome would join, without "
+             "mutating the index")
+    _add_genome_inputs(ixq)
+    ixq.add_argument("--sketch-cache",
+                     help="Directory for the persistent sketch cache "
+                          "(also via GALAH_TPU_CACHE)")
+    ixq.add_argument("--threads", "-t", type=int, default=1)
+    ixq.add_argument("--output",
+                     help="Output TSV of query, decision, "
+                          "representative, ANI (default: stdout)")
+    ixr = ixsub.add_parser(
+        "remove",
+        help="Tombstone genomes and locally re-elect their clusters")
+    _add_genome_inputs(ixr)
+    ixsub.add_parser(
+        "fsck",
+        help="Audit the on-disk index: commit-pointer integrity, log "
+             "checksums, cluster invariants (never mutates; jax-free)")
     parser._subcommand_parsers = {"cluster": c, "cluster-validate": v,
                                   "dist": dd, "lint": li, "report": rp,
-                                  "perf": pf}
+                                  "perf": pf, "index": ix}
     return parser
 
 
@@ -697,6 +811,197 @@ def run_perf_cmd(args) -> int:
     return 1 if bad else 0
 
 
+def _index_order_genomes(genomes, args):
+    """Quality-order genomes for index build/insert; with no quality
+    input, fall back to input order LOUDLY: a distinct warn_once key,
+    a resilience event, and a counter (the run report shows the index
+    was grown unranked — representative choice is input-order luck)."""
+    from galah_tpu.api import quality_order_genomes
+
+    ordered, used_quality = quality_order_genomes(
+        genomes, vars(args),
+        threads=int(getattr(args, "threads", 1) or 1),
+        missing_key="index-quality-fallback",
+        missing_msg="Since CheckM input is missing, genomes enter the "
+                    "index in input order, not quality order — "
+                    "representative selection is unranked. Pass "
+                    "--checkm-tab-table / --checkm2-quality-report / "
+                    "--genome-info to rank them")
+    if not used_quality:
+        from galah_tpu.obs import events
+        from galah_tpu.obs import metrics as obs_metrics
+
+        events.record("index-quality-fallback", n_genomes=len(ordered))
+        obs_metrics.counter(
+            "index.quality_fallback",
+            help="Index build/insert batches ordered by input order "
+                 "because no quality input was given",
+            unit="batches").inc()
+    return ordered
+
+
+def _run_index_fsck(index_dir: str) -> int:
+    # Pure file I/O + checksum math: usable on hosts with no
+    # accelerator, so it must stay out of the jax-touching path below.
+    from galah_tpu.index import store as index_store
+
+    rep = index_store.fsck(index_dir)
+    print(f"index {rep['path']}: generation {rep['generation']}, "
+          f"{rep['genomes']} genome(s), {rep['clusters']} cluster(s), "
+          f"{rep['pairs']} pair(s), {rep['tombstones']} tombstone(s)")
+    for w in rep["warnings"]:
+        print(f"  warning: {w}")
+    for p in rep["problems"]:
+        print(f"  PROBLEM: {p}")
+    print("fsck: OK" if rep["ok"] else "fsck: FAILED")
+    return 0 if rep["ok"] else 1
+
+
+def run_index(args) -> int:
+    import time as _time
+
+    from galah_tpu import obs
+    from galah_tpu.config import env_value
+    from galah_tpu.resilience import interrupt
+
+    action = getattr(args, "index_action", None)
+    if action is None:
+        logger.error("index needs an action: build, insert, query, "
+                     "remove, or fsck")
+        return 1
+    index_dir = (getattr(args, "index_dir", None)
+                 or env_value("GALAH_TPU_INDEX_DIR"))
+    if not index_dir:
+        logger.error("no index directory: pass --index-dir or set "
+                     "GALAH_TPU_INDEX_DIR")
+        return 1
+    if action == "fsck":
+        return _run_index_fsck(index_dir)
+    # Same telemetry lifecycle as run_cluster: reset shared state, arm
+    # cooperative preemption, always finalize the report/trace.
+    # wall-clock stamp for the report header, not a duration measure
+    started_at = _time.time()  # galah-lint: ignore[GL701]
+    timing.reset()
+    obs.reset_run()
+    interrupt.reset()
+    interrupt.install()
+    trace_path = (getattr(args, "trace_events", None)
+                  or env_value("GALAH_OBS_TRACE_EVENTS"))
+    if trace_path:
+        obs.trace.start(trace_path)
+    report_path = (getattr(args, "run_report", None)
+                   or env_value("GALAH_OBS_REPORT"))
+    try:
+        return _run_index_inner(args, action, index_dir)
+    finally:
+        interrupt.uninstall()
+        obs.finalize("index", report_path=report_path,
+                     started_at=started_at)
+
+
+def _run_index_inner(args, action: str, index_dir: str) -> int:
+    import sys as _sys
+    import time as _time
+
+    from galah_tpu.genome_inputs import parse_genome_inputs
+    from galah_tpu.index import incremental
+    from galah_tpu.index.store import IndexStore
+    from galah_tpu.resilience import interrupt
+
+    genomes = parse_genome_inputs(
+        genome_fasta_files=args.genome_fasta_files,
+        genome_fasta_list=getattr(args, "genome_fasta_list", None),
+        genome_fasta_directory=getattr(args, "genome_fasta_directory",
+                                       None),
+        genome_fasta_extension=getattr(args, "genome_fasta_extension",
+                                       "fna"),
+    )
+
+    if action == "build":
+        ordered = _index_order_genomes(genomes, args)
+        info = incremental.build(
+            index_dir, ordered,
+            ani=parse_percentage(args.ani, "--ani"),
+            precluster_ani=parse_percentage(args.precluster_ani,
+                                            "--precluster-ani"),
+            algo=args.hash_algorithm,
+            cache_dir=getattr(args, "sketch_cache", None),
+            threads=args.threads)
+        logger.info("Built index at %s: generation %d, %d genomes in "
+                    "%d clusters", index_dir, info["generation"],
+                    info["genomes"], info["clusters"])
+        return 0
+
+    idx = IndexStore(index_dir)
+    if action == "insert":
+        ordered = _index_order_genomes(genomes, args)
+        prior = idx.load_interruptions()
+        if prior or getattr(args, "resume", False):
+            from galah_tpu.obs import events
+
+            interrupt.note_resume(index_dir, len(prior))
+            events.record("resumed", index_dir=index_dir,
+                          prior_interruptions=len(prior))
+        try:
+            info = incremental.insert(
+                idx, ordered,
+                cache_dir=getattr(args, "sketch_cache", None),
+                threads=args.threads,
+                batch=getattr(args, "batch", None))
+        except interrupt.PreemptionRequested as e:
+            from galah_tpu.obs import events
+
+            events.record("preempted", signal=e.signame,
+                          boundary=e.boundary)
+            idx.record_interruption({
+                "signal": e.signame,
+                "boundary": e.boundary,
+                # wall-clock stamp for the chain record, not a duration
+                "ts": _time.time(),  # galah-lint: ignore[GL701]
+            })
+            logger.warning(
+                "Preempted (%s): stopped at safe boundary %r. The "
+                "index at %s is loadable at its last committed "
+                "generation; rerun the same insert (--resume) to "
+                "converge to the uninterrupted result. Exiting %d.",
+                e.signame, e.boundary, index_dir,
+                interrupt.EXIT_PREEMPTED)
+            return interrupt.EXIT_PREEMPTED
+        logger.info("Inserted %d genome(s) (%d skipped as already "
+                    "present): generation %d, %d genomes in %d "
+                    "clusters, %d new representative(s)",
+                    info["inserted"], info["skipped"],
+                    info["generation"], info["genomes"],
+                    info["clusters"], info.get("new_reps", 0))
+        return 0
+
+    if action == "query":
+        results = incremental.query(
+            idx, genomes,
+            cache_dir=getattr(args, "sketch_cache", None),
+            threads=args.threads)
+        out = open(args.output, "w") if args.output else _sys.stdout
+        try:
+            out.write("query\tdecision\trepresentative\tani\n")
+            for r in results:
+                ani = (f"{r['ani'] * 100:.4f}"
+                       if r["ani"] is not None else "NA")
+                out.write(f"{r['path']}\t{r['decision']}\t"
+                          f"{r['rep'] or 'NA'}\t{ani}\n")
+        finally:
+            if args.output:
+                out.close()
+        return 0
+
+    # remove
+    for p in genomes:
+        info = incremental.remove(idx, p)
+        logger.info("Removed %s: generation %d, %d genomes in %d "
+                    "clusters remain", p, info["generation"],
+                    info["genomes"], info["clusters"])
+    return 0
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -769,6 +1074,8 @@ def main(argv=None) -> int:
             return run_cluster(args)
         elif args.subcommand == "dist":
             return run_dist(args)
+        elif args.subcommand == "index":
+            return run_index(args)
         else:
             return run_cluster_validate(args)
     except (ValueError, OSError, KeyError) as e:

@@ -113,6 +113,14 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
          help="Directory for the persistent sketch/profile cache; the "
               "--sketch-cache flag's env twin and loses to it. Unset "
               "disables caching"),
+    Flag("GALAH_TPU_INDEX_DIR", section="runtime",
+         help="Directory of the persistent versioned sketch index; "
+              "the --index-dir flag's env twin and loses to it"),
+    Flag("GALAH_TPU_INDEX_BATCH", kind="int", default="32",
+         section="resilience",
+         help="Genomes per durable append batch of `index insert` "
+              "(the preemption safe-boundary granularity: a kill "
+              "loses at most one batch of uncommitted appends)"),
     # -- kernel / device policy -------------------------------------------
     Flag("GALAH_TPU_DENSE_PAIRS", kind="bool", section="kernel",
          help="Force the dense O(N^2) pairwise pass (skip the sparse "

@@ -1,0 +1,33 @@
+"""Persistent versioned sketch index: the incremental serving layer.
+
+``store`` is the durable on-disk format (framed-JSONL logs, generation
+manifests, commit pointer, fsck); ``incremental`` is the update engine
+(build / insert / query / remove) that re-derives the cluster engine's
+greedy decisions from persisted sketches and pairs. See docs/index.md.
+
+This package module stays stdlib-only at import: the run-report
+assembler reads the snapshot below on hosts with no accelerator, and
+must never drag jax (or even numpy) in through it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+#: Last index operation's summary, mirrored into the run report's
+#: ``index`` section by obs/report.assemble (reset with reset_run).
+_SNAPSHOT: Optional[Dict[str, Any]] = None
+
+
+def set_snapshot(snap: Dict[str, Any]) -> None:
+    global _SNAPSHOT
+    _SNAPSHOT = dict(snap)
+
+
+def snapshot() -> Optional[Dict[str, Any]]:
+    return dict(_SNAPSHOT) if _SNAPSHOT is not None else None
+
+
+def reset() -> None:
+    global _SNAPSHOT
+    _SNAPSHOT = None

@@ -1,0 +1,523 @@
+"""Incremental update engine over the persistent sketch index.
+
+The soundness argument, in one place: with the MinHash preclusterer and
+the sketch-ANI clusterer sharing a method (the engine's
+``skip_clusterer`` path), every greedy decision the cluster engine
+makes is served from the precluster pair cache — genome ``i`` is a
+representative iff no earlier representative with a cached pair has
+ANI >= threshold (cluster/engine.py ``_find_representatives``), and a
+non-representative joins the argmax-ANI representative with ties to the
+lowest index (``_find_memberships``). Decisions are therefore pure
+functions of (greedy genome order, thresholded pair set). The index
+persists exactly those two things, so:
+
+  * *insert* appends new genomes AFTER every existing one in the greedy
+    order. Existing genomes' representative decisions only ever looked
+    at lower indices — they are untouched — and each new genome needs
+    only its own pairs, screened against representatives first
+    (rep-first screening is sound precisely because of the greedy
+    order). The only existing state that can change is membership:
+    an existing non-representative re-homes to a NEW representative iff
+    its ANI there is strictly higher (the engine's ascending-rep argmax
+    with strict improvement). Only those clusters are touched.
+  * *query* runs the same screen against the live representatives
+    without appending anything.
+  * *remove* tombstones one genome; if it was a representative, its
+    cluster re-elects the lowest-index remaining member locally (a
+    deliberate local repair — documented in docs/index.md as not
+    equivalent to a from-scratch run).
+
+New-pair ANIs are computed host-side by an exact numpy mirror of the
+device merge statistics (ops/pairwise.py ``_pair_stats``): integer
+(common, total) plus the shared f64 ``stats_to_ani_f64`` formula, so an
+inserted index is BYTE-IDENTICAL to a from-scratch build over the same
+corpus (tests/test_index.py plants the proof).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from galah_tpu.cluster.partition import partition_preclusters
+from galah_tpu.index import store as index_store
+from galah_tpu.index.store import IndexState, IndexStore
+from galah_tpu.ops.pairwise import ani_to_jaccard, stats_to_ani_f64
+
+logger = logging.getLogger(__name__)
+
+# Pipeline contract, machine-checked by `galah-tpu lint` (GL10xx): the
+# insert sketch stage is a generator over ops/sketch_stream's streaming
+# pipeline and must stay streamed (GL1001/GL1002).
+PIPELINE_STAGE = {
+    # the occupancy gauge is emitted by ops/sketch_stream.py, which
+    # this stage delegates to — declaring it here too would contract
+    # this module to emit it a second time (GL1004)
+    "streaming": ["iter_insert_sketches"],
+}
+
+# Concurrency contract (GL805/GalahSan): this module holds no locked
+# shared state of its own — mutation is serialized by the single-writer
+# IndexStore (see index/store.py's GUARDED_BY), and the streamed sketch
+# stage's locks live in ops/sketch_stream.py.
+GUARDED_BY: Dict[str, str] = {}
+LOCK_ORDER: List[str] = []
+
+
+class SketchANIClusterer:
+    """Clusterer shim that names the preclusterer's own method so the
+    engine takes the ``skip_clusterer`` path: sketch ANI IS the exact
+    ANI, every decision comes from the precluster pair cache, and a
+    persisted pair set can re-derive the engine's output exactly."""
+
+    def __init__(self, ani_threshold: float) -> None:
+        self.ani_threshold = float(ani_threshold)
+
+    def method_name(self) -> str:
+        return "finch"
+
+
+def _default_batch() -> int:
+    from galah_tpu.config import env_value
+
+    return max(1, int(env_value("GALAH_TPU_INDEX_BATCH")))
+
+
+def _sketch_store(index: IndexStore, cache_dir: Optional[str]):
+    from galah_tpu.backends.minhash_backend import SketchStore
+    from galah_tpu.io import diskcache
+
+    p = index.sketch_params
+    return SketchStore(p["sketch_size"], p["k"], seed=p["seed"],
+                       cache=diskcache.get_cache(cache_dir),
+                       algo=p["algo"])
+
+
+def iter_insert_sketches(
+        paths: Sequence[str], sketch_store,
+        threads: int = 1) -> Iterator[Tuple[str, Any]]:
+    """The insert/query sketch stage: (path, sketch) over the streaming
+    ingest->sketch pipeline. Genomes already in the run's sketch store
+    or the disk cache yield without touching FASTA — the property the
+    "resketch only the new genomes" acceptance counter measures."""
+    from galah_tpu.ops.sketch_stream import iter_path_sketches
+
+    for path, sk in iter_path_sketches(paths, sketch_store,
+                                       threads=threads):
+        yield path, sk
+
+
+# -- exact host-side pair statistics -----------------------------------
+
+
+def merge_stats(a: np.ndarray, b: np.ndarray,
+                sketch_size: int) -> Tuple[int, int]:
+    """Integer (common, total) of two sorted-distinct bottom-k sketches
+    over the first ``min(sketch_size, |union|)`` union elements — the
+    exact numpy twin of the device kernel's ``_pair_stats``
+    (ops/pairwise.py), so host-computed insert pairs are bit-identical
+    to the device-computed build pairs."""
+    na, nb = len(a), len(b)
+    if na == 0 or nb == 0:
+        return 0, min(sketch_size, na + nb)
+    pos = np.searchsorted(b, a)
+    safe = np.minimum(pos, nb - 1)
+    match = (pos < nb) & (b[safe] == a)
+    n_common = int(match.sum())
+    total = min(sketch_size, na + nb - n_common)
+    # union rank of a[i]: a-elements before it + b-elements below it -
+    # matches already counted once
+    urank = np.arange(na) + pos - (np.cumsum(match) - match)
+    common = int((match & (urank < total)).sum())
+    return common, total
+
+
+def pair_ani(a: np.ndarray, b: np.ndarray, sketch_size: int, k: int,
+             j_thr: float) -> Optional[float]:
+    """ANI of a sketch pair under the precluster keep rule, or None if
+    the pair falls below it — mirrors ops/pairwise.threshold_pairs:
+    keep iff common > 0 and common >= jaccard_threshold * total."""
+    common, total = merge_stats(a, b, sketch_size)
+    if common <= 0 or float(common) < j_thr * total:
+        return None
+    return float(stats_to_ani_f64(np.asarray([common]),
+                                  np.asarray([total]), k)[0])
+
+
+# -- decision re-derivation (the engine's greedy semantics) ------------
+
+
+def screen_new_genomes(state: IndexState, new_start: int,
+                       thr: float) -> Dict[str, int]:
+    """Extend representatives/membership for genomes ``[new_start, n)``
+    and re-home affected existing members, mutating `state` in place.
+
+    Replicates the engine's decisions exactly (see the module
+    docstring); returns counters {new_reps, new_members, reassigned}.
+    """
+    pairs = state.pairs
+    tomb = state.tombstones
+    # ascending live rep list: state.reps is sorted and new genomes are
+    # screened in ascending index order, so appends keep it sorted —
+    # no hash-ordered set iteration anywhere near pair decisions
+    rep_list = [r for r in state.reps if r not in tomb]
+    rep_all = set(state.reps)
+    new_reps: List[int] = []
+    joiners: List[int] = []
+    # pass 1 — representative decisions. Genome g's candidate set is
+    # the representatives chosen before it, and the greedy order means
+    # those all have lower indices (rep-first screening is sound).
+    for g in range(new_start, state.n_genomes):
+        if g in tomb:
+            continue
+        if not any(pairs[(r, g)] >= thr for r in rep_list
+                   if (r, g) in pairs):
+            rep_all.add(g)
+            rep_list.append(g)
+            new_reps.append(g)
+        else:
+            joiners.append(g)
+    # pass 2 — membership. The engine's argmax visits the FULL final
+    # rep list (a non-rep can join a rep with a higher index), so this
+    # must run after every rep decision: ascending reps, strict
+    # improvement (ties to the lowest rep index), no threshold.
+    for g in joiners:
+        best_r, best_ani = None, None
+        for r in rep_list:
+            ani = pairs.get((min(g, r), max(g, r)))
+            if ani is not None and (best_ani is None or ani > best_ani):
+                best_r, best_ani = r, ani
+        state.membership[g] = best_r
+    new_members = len(joiners)
+    # existing non-reps with a pair to a NEW representative: the
+    # engine's argmax visits reps ascending with strict >, and every
+    # new rep index exceeds every old one — so re-home iff strictly
+    # better than the current best
+    reassigned = 0
+    if new_reps:
+        for m, cur in list(state.membership.items()):
+            if m >= new_start or m in tomb:
+                continue
+            cur_key = (min(m, cur), max(m, cur))
+            best_r, best_ani = cur, pairs.get(cur_key)
+            for r in new_reps:
+                ani = pairs.get((m, r))
+                if ani is not None and (best_ani is None
+                                        or ani > best_ani):
+                    best_r, best_ani = r, ani
+            if best_r != cur:
+                state.membership[m] = best_r
+                reassigned += 1
+    state.reps = sorted(rep_all)
+    return {"new_reps": len(new_reps), "new_members": new_members,
+            "reassigned": reassigned}
+
+
+def clusters_from_state(state: IndexState) -> List[List[int]]:
+    """The engine-ordered cluster list: preclusters biggest-first (ties
+    to the lowest genome index), representatives ascending within one,
+    each cluster ``[rep] + members ascending`` — exactly how
+    cluster/engine.py assembles its output, so a from-scratch run and
+    an index roundtrip compare byte-identical."""
+    live = set(state.live)
+    keys = [kk for kk in state.pairs
+            if kk[0] in live and kk[1] in live]
+    rep_set = set(state.reps)
+    members: Dict[int, List[int]] = {}
+    for g, r in state.membership.items():
+        members.setdefault(r, []).append(g)
+    out: List[List[int]] = []
+    for comp in partition_preclusters(state.n_genomes, keys):
+        for r in comp:
+            if r in rep_set:
+                out.append([r] + sorted(members.get(r, [])))
+    return out
+
+
+def cluster_paths(state: IndexState) -> List[List[str]]:
+    return [[state.genomes[g] for g in c]
+            for c in clusters_from_state(state)]
+
+
+# -- operations --------------------------------------------------------
+
+
+def _publish(state: IndexState, op: str,
+             extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Gauges + run-report snapshot after any index operation."""
+    from galah_tpu import index as index_pkg
+    from galah_tpu.obs import metrics as obs_metrics
+
+    live = len(state.live)
+    obs_metrics.gauge(
+        "index.generation",
+        help="Committed generation of the persistent sketch index",
+        unit="generation").set(float(state.generation))
+    obs_metrics.gauge(
+        "index.genomes",
+        help="Live (non-tombstoned) genomes in the sketch index",
+        unit="genomes").set(float(live))
+    obs_metrics.gauge(
+        "index.clusters",
+        help="Clusters (representatives) in the sketch index",
+        unit="clusters").set(float(len(state.reps)))
+    snap: Dict[str, Any] = {
+        "op": op,
+        "generation": state.generation,
+        "genomes": live,
+        "clusters": len(state.reps),
+        "tombstones": len(state.tombstones),
+        "pairs": len(state.pairs),
+    }
+    if extra:
+        snap.update(extra)
+    index_pkg.set_snapshot(snap)
+    return snap
+
+
+def build(path: str, ordered_paths: Sequence[str], ani: float,
+          precluster_ani: float,
+          sketch_size: Optional[int] = None, k: Optional[int] = None,
+          seed: Optional[int] = None, algo: Optional[str] = None,
+          cache_dir: Optional[str] = None,
+          threads: int = 1) -> Dict[str, Any]:
+    """Build (or finish a killed build of) the index at `path` from the
+    quality-ordered `ordered_paths`, committing generation 1.
+
+    The pair pass runs the SAME device pipeline a cluster run uses
+    (backends/minhash_backend.distances), so the persisted ANIs carry
+    the pipeline's bit-exactness guarantees verbatim.
+    """
+    from galah_tpu.backends.minhash_backend import MinHashPreclusterer
+    from galah_tpu.config import Defaults
+    from galah_tpu.io import diskcache
+
+    params = index_store.index_params(
+        ani=ani, precluster_ani=precluster_ani,
+        sketch_size=(Defaults.MINHASH_SKETCH_SIZE
+                     if sketch_size is None else sketch_size),
+        k=Defaults.MINHASH_KMER if k is None else k,
+        seed=Defaults.MINHASH_SEED if seed is None else seed,
+        algo=Defaults.HASH_ALGO if algo is None else algo)
+    idx = IndexStore(path, params=params, create=True)
+    if idx.generation():
+        raise ValueError(
+            f"index at {path} is already built (generation "
+            f"{idx.generation()}); use `galah-tpu index insert`")
+    state = idx.begin_mutation()
+
+    paths = [os.path.abspath(p) for p in ordered_paths]
+    if len(set(os.path.realpath(p) for p in paths)) != len(paths):
+        raise ValueError("duplicate genome paths in index build input")
+
+    pre = MinHashPreclusterer(
+        min_ani=params["precluster_ani"],
+        sketch_size=params["sketch_size"], k=params["k"],
+        cache=diskcache.get_cache(cache_dir),
+        hash_algo=params["algo"], threads=threads)
+    pair_cache = pre.distances(paths)
+
+    for g, p in enumerate(paths):
+        sk = pre.store.get_cached(p)
+        if sk is None:  # pragma: no cover - distances always fills it
+            sk = pre.store.get(p)
+        key = index_store.genome_key(p, idx.sketch_params)
+        idx.append_genome(g, p, key)
+        idx.append_sketch(g, sk.hashes)
+        state.genomes.append(p)
+        state.keys.append(key)
+        state.sketches.append(np.asarray(sk.hashes, dtype=np.uint64))
+    # grouped by the higher index — the order insert appends in, so a
+    # grown index and a from-scratch build are byte-identical
+    pair_rows = sorted(
+        ((i, j, ani_val) for (i, j), ani_val in pair_cache.items()),
+        key=lambda row: (row[1], row[0]))
+    idx.append_pairs(pair_rows)
+    state.pairs = {(i, j): v for i, j, v in pair_rows}
+
+    counts = screen_new_genomes(state, 0, params["ani"])
+    generation = idx.commit(state)
+    logger.info(
+        "Built index at %s: generation %d, %d genomes, %d clusters, "
+        "%d pairs", path, generation, len(state.genomes),
+        len(state.reps), len(state.pairs))
+    return _publish(state, "build", counts)
+
+
+def insert(idx: IndexStore, new_paths: Sequence[str],
+           cache_dir: Optional[str] = None, threads: int = 1,
+           batch: Optional[int] = None) -> Dict[str, Any]:
+    """Insert quality-ordered `new_paths`, committing one new
+    generation. Only the new genomes are sketched (streamed through
+    ops/sketch_stream); only their pairs are computed (host-side exact
+    merge statistics); only clusters a new representative borders can
+    change. Appends are durable per record and the sketch cache is
+    warm after a kill, so an interrupted insert resumed from the prior
+    generation converges to the same bytes as an uninterrupted one.
+    """
+    from galah_tpu.obs import metrics as obs_metrics
+    from galah_tpu.resilience import interrupt
+
+    state = idx.begin_mutation()
+    if state.generation == 0:
+        raise ValueError(
+            f"index at {idx.path} has no committed generation; run "
+            "`galah-tpu index build` first")
+    known = {os.path.realpath(p) for p in state.genomes}
+    fresh: List[str] = []
+    skipped = 0
+    for p in new_paths:
+        rp = os.path.realpath(p)
+        if rp in known:
+            skipped += 1
+            continue
+        known.add(rp)
+        fresh.append(os.path.abspath(p))
+    if skipped:
+        logger.info("Skipping %d genome(s) already in the index",
+                    skipped)
+    if not fresh:
+        return _publish(state, "insert",
+                        {"inserted": 0, "skipped": skipped})
+
+    params = idx.params
+    j_thr = ani_to_jaccard(params["precluster_ani"], params["k"])
+    sk_store = _sketch_store(idx, cache_dir)
+    batch = _default_batch() if batch is None else max(1, int(batch))
+    new_start = state.n_genomes
+    hist = obs_metrics.histogram(
+        "index.insert_seconds",
+        help="Wall seconds per index insert operation", unit="s")
+    with hist.time():
+        for b0 in range(0, len(fresh), batch):
+            chunk = fresh[b0:b0 + batch]
+            for p, sk in iter_insert_sketches(chunk, sk_store,
+                                              threads=threads):
+                g = len(state.genomes)
+                hashes = np.asarray(sk.hashes, dtype=np.uint64)
+                key = index_store.genome_key(p, idx.sketch_params)
+                rows = []
+                for u in range(g):
+                    if u in state.tombstones:
+                        continue
+                    ani_val = pair_ani(state.sketches[u], hashes,
+                                       params["sketch_size"],
+                                       params["k"], j_thr)
+                    if ani_val is not None:
+                        rows.append((u, g, ani_val))
+                idx.append_genome(g, p, key)
+                idx.append_sketch(g, hashes)
+                idx.append_pairs(rows)
+                state.genomes.append(p)
+                state.keys.append(key)
+                state.sketches.append(hashes)
+                for u, gg, v in rows:
+                    state.pairs[(u, gg)] = v
+            # safe boundary: this batch's records are durable (per-
+            # record fsync); a preemption here leaves the index
+            # loadable at the prior generation and a resume redoes
+            # only the uncommitted work, with every sketch cache-warm
+            interrupt.check("index-batch-saved")
+        counts = screen_new_genomes(state, new_start, params["ani"])
+        generation = idx.commit(state)
+    logger.info(
+        "Inserted %d genome(s) into %s: generation %d, %d clusters "
+        "(%d new rep(s), %d reassigned)", len(fresh), idx.path,
+        generation, len(state.reps), counts["new_reps"],
+        counts["reassigned"])
+    counts.update({"inserted": len(fresh), "skipped": skipped})
+    return _publish(state, "insert", counts)
+
+
+def query(idx: IndexStore, paths: Sequence[str],
+          cache_dir: Optional[str] = None,
+          threads: int = 1) -> List[Dict[str, Any]]:
+    """Answer "which cluster would this genome join" for each path
+    against the committed state, mutating nothing.
+
+    The decision replays the insert screen for a single genome: join
+    the argmax-ANI representative if any pair reaches the cluster
+    threshold, otherwise the genome would found a new cluster.
+    """
+    from galah_tpu.obs import metrics as obs_metrics
+
+    state = idx.load()
+    params = idx.params
+    j_thr = ani_to_jaccard(params["precluster_ani"], params["k"])
+    reps = [r for r in state.reps if r not in state.tombstones]
+    hist = obs_metrics.histogram(
+        "index.query_seconds",
+        help="Wall seconds per single-genome index query", unit="s")
+    sk_store = _sketch_store(idx, cache_dir)
+    sketches: Dict[str, Any] = {}
+    for p, sk in iter_insert_sketches(
+            [os.path.abspath(p) for p in paths], sk_store,
+            threads=threads):
+        sketches[p] = np.asarray(sk.hashes, dtype=np.uint64)
+    out: List[Dict[str, Any]] = []
+    for p in (os.path.abspath(q) for q in paths):
+        with hist.time():
+            hashes = sketches[p]
+            best_r, best_ani, hits = None, None, 0
+            for r in reps:
+                ani_val = pair_ani(state.sketches[r], hashes,
+                                   params["sketch_size"], params["k"],
+                                   j_thr)
+                if ani_val is None:
+                    continue
+                hits += 1
+                if best_ani is None or ani_val > best_ani:
+                    best_r, best_ani = r, ani_val
+            joins = best_ani is not None and best_ani >= params["ani"]
+            out.append({
+                "path": p,
+                "decision": "member" if joins else "novel",
+                "rep": state.genomes[best_r] if joins else None,
+                "rep_index": best_r if joins else None,
+                "ani": best_ani,
+                "candidates": hits,
+            })
+    return out
+
+
+def remove(idx: IndexStore, path: str) -> Dict[str, Any]:
+    """Tombstone one genome and repair only its own cluster: a removed
+    representative's cluster re-elects its lowest-index remaining
+    member; every other cluster is untouched (local repair, not a
+    from-scratch equivalence — see docs/index.md)."""
+    state = idx.begin_mutation()
+    if state.generation == 0:
+        raise ValueError(
+            f"index at {idx.path} has no committed generation; run "
+            "`galah-tpu index build` first")
+    rp = os.path.realpath(path)
+    target = next((g for g, p in enumerate(state.genomes)
+                   if os.path.realpath(p) == rp
+                   and g not in state.tombstones), None)
+    if target is None:
+        raise ValueError(f"{path} is not a live genome of the index "
+                         f"at {idx.path}")
+    state.tombstones.add(target)
+    reelected: Optional[int] = None
+    if target in state.membership:
+        del state.membership[target]
+    else:  # a representative: local re-election
+        orphans = sorted(g for g, r in state.membership.items()
+                         if r == target)
+        state.reps = [r for r in state.reps if r != target]
+        if orphans:
+            reelected = orphans[0]
+            state.membership.pop(reelected)
+            state.reps = sorted(state.reps + [reelected])
+            for g in orphans[1:]:
+                state.membership[g] = reelected
+    generation = idx.commit(state)
+    logger.info(
+        "Removed genome %d (%s) from %s: generation %d%s", target, rp,
+        idx.path, generation,
+        f", re-elected {reelected}" if reelected is not None else "")
+    return _publish(state, "remove",
+                    {"removed": target, "reelected": reelected})

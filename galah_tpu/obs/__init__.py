@@ -40,6 +40,11 @@ def reset_run() -> None:
     metrics.reset()
     events.reset()
     profile.reset()
+    # Index-operation snapshot (stdlib-only package, safe to import
+    # here): one run = at most one index op's summary in the report.
+    from galah_tpu import index as index_pkg
+
+    index_pkg.reset()
 
 
 def finalize(subcommand: str,

@@ -26,7 +26,7 @@ logger = logging.getLogger(__name__)
 
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
                            "run_report.schema.json")
-REPORT_VERSION = 4
+REPORT_VERSION = 5
 
 # disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
 _DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
@@ -188,6 +188,14 @@ def assemble(subcommand: str,
             report["sanitizer"] = san_summary
     except Exception:  # additive section (v4); never lose a report
         logger.debug("sanitizer summary failed", exc_info=True)
+    try:
+        from galah_tpu import index as index_pkg
+
+        idx_snap = index_pkg.snapshot()
+        if idx_snap is not None:
+            report["index"] = idx_snap
+    except Exception:  # additive section (v5); never lose a report
+        logger.debug("index snapshot failed", exc_info=True)
     if lint is not None:
         report["lint"] = lint
     return report
@@ -351,6 +359,18 @@ def render(report: dict) -> str:
             f"{san.get('inversions', 0)} inversions, "
             f"{san.get('races', 0)} races",
         ]
+    idx = report.get("index")
+    if idx is not None:
+        lines += [
+            "",
+            "sketch index:",
+            f"  op: {idx.get('op')}   "
+            f"generation: {idx.get('generation')}",
+            f"  {idx.get('genomes', 0)} genome(s) in "
+            f"{idx.get('clusters', 0)} cluster(s), "
+            f"{idx.get('pairs', 0)} pair(s), "
+            f"{idx.get('tombstones', 0)} tombstone(s)",
+        ]
     lint = report.get("lint")
     if lint is not None:
         fams = ", ".join(f"{fam}={n}" for fam, n in
@@ -485,6 +505,16 @@ def diff(a: dict, b: dict, label_a: str = "A",
                     "undeclared_edges", "inversions", "races",
                     "unexercised"):
             va, vb = int(na.get(key, 0)), int(nb.get(key, 0))
+            lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
+
+    # index drift — additive v5 section, .get throughout
+    ia, ib = a.get("index"), b.get("index")
+    if ia is not None or ib is not None:
+        ia, ib = ia or {}, ib or {}
+        lines += ["", "index drift:"]
+        for key in ("generation", "genomes", "clusters", "pairs",
+                    "tombstones"):
+            va, vb = int(ia.get(key, 0)), int(ib.get(key, 0))
             lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
 
     la, lb = a.get("lint"), b.get("lint")

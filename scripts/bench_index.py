@@ -1,0 +1,155 @@
+"""Incremental-index bench: build once, insert 10%, sweep query latency.
+
+The index subsystem's value claim is that growing a dereplicated
+catalogue costs the marginal work, not the from-scratch work: an
+insert sketches ONLY the new genomes, and a query answers from the
+committed state in milliseconds. This bench measures both sides on a
+planted-family corpus:
+
+  1. ``build`` over 90% of the corpus (the device sketch pipeline +
+     persisted decisions) — amortized once per catalogue;
+  2. ``insert`` of the remaining 10% — wall seconds, genomes/s, and
+     the ``sketch.minhash_computed`` counter delta proving only the
+     new genomes were resketched;
+  3. a warm ``query`` latency sweep (every inserted genome against the
+     committed state) — p50/p95 milliseconds per genome, the
+     interactive-service number (acceptance: warm p50 < 50 ms on CPU).
+
+Usage: python scripts/bench_index.py [--families 16] [--members 5]
+       [--length 20000] [--queries 0 (= all inserted)] [--budget S]
+Prints one JSON line per measurement and INDEX_JSON with the summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    vs = sorted(values)
+    i = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+    return vs[i]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--families", type=int, default=16)
+    ap.add_argument("--members", type=int, default=5)
+    ap.add_argument("--length", type=int, default=20_000)
+    ap.add_argument("--queries", type=int, default=0,
+                    help="query sweep size (0 = every inserted genome)")
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--budget", type=float, default=0.0,
+                    help="soft self-budget in seconds (skips the query "
+                         "sweep when the build+insert already spent it)")
+    ap.add_argument("--keep", action="store_true")
+    args = ap.parse_args()
+    t_start = time.perf_counter()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from scripts.chaos_run import make_workload
+
+    from galah_tpu.index import incremental
+    from galah_tpu.index.store import IndexStore
+    from galah_tpu.obs import metrics as obs_metrics
+
+    work = tempfile.mkdtemp(prefix="galah_bench_index_")
+    out = {"n_genomes": args.families * args.members}
+    try:
+        gdir = os.path.join(work, "genomes")
+        os.makedirs(gdir)
+        genomes = make_workload(gdir, seed=7, families=args.families,
+                                members=args.members,
+                                length=args.length)
+        # the insert slice is ~10%: the last member of every ~10th
+        # family joins an existing cluster, one whole held-out family
+        # founds a new one — both decision paths under measurement
+        insert = genomes[-args.members:] \
+            + genomes[args.members - 1:-args.members:args.members * 10]
+        base = [g for g in genomes if g not in insert]
+        out["n_build"] = len(base)
+        out["n_insert"] = len(insert)
+        cache = os.path.join(work, "cache")
+        idx_dir = os.path.join(work, "idx")
+
+        t0 = time.perf_counter()
+        info = incremental.build(idx_dir, base, ani=0.95,
+                                 precluster_ani=0.90, cache_dir=cache,
+                                 threads=args.threads)
+        out["build_s"] = round(time.perf_counter() - t0, 3)
+        out["build_genomes_per_sec"] = round(
+            len(base) / max(out["build_s"], 1e-9), 2)
+        out["build_clusters"] = info["clusters"]
+        print(json.dumps({"stage": "build", **{
+            k: out[k] for k in ("n_build", "build_s",
+                                "build_clusters")}}), flush=True)
+
+        def _resketched():
+            snap = obs_metrics.snapshot().get("sketch.minhash_computed")
+            return int(snap.get("value", 0)) if snap else 0
+
+        idx = IndexStore(idx_dir)
+        before = _resketched()
+        t0 = time.perf_counter()
+        info = incremental.insert(idx, insert, cache_dir=cache,
+                                  threads=args.threads)
+        out["insert_s"] = round(time.perf_counter() - t0, 3)
+        out["insert_genomes_per_sec"] = round(
+            len(insert) / max(out["insert_s"], 1e-9), 2)
+        out["insert_resketched"] = _resketched() - before
+        out["insert_new_reps"] = info.get("new_reps", 0)
+        out["clusters"] = info["clusters"]
+        print(json.dumps({"stage": "insert", **{
+            k: out[k] for k in ("n_insert", "insert_s",
+                                "insert_resketched",
+                                "insert_new_reps")}}), flush=True)
+        if out["insert_resketched"] > len(insert):
+            out["error"] = (
+                f"insert resketched {out['insert_resketched']} "
+                f"genomes, expected <= {len(insert)}")
+
+        spent = time.perf_counter() - t_start
+        if args.budget and spent > args.budget:
+            print(f"budget spent ({spent:.0f}s); skipping query sweep",
+                  flush=True)
+        else:
+            qpaths = insert if not args.queries \
+                else insert[:args.queries]
+            # warm the query path once (sketches are cache-hits after
+            # the insert; the first call pays one-time imports)
+            incremental.query(idx, qpaths[:1], cache_dir=cache,
+                              threads=args.threads)
+            lat_ms = []
+            for p in qpaths:
+                t0 = time.perf_counter()
+                incremental.query(idx, [p], cache_dir=cache,
+                                  threads=args.threads)
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+            out["query_n"] = len(lat_ms)
+            out["query_p50_ms"] = round(_percentile(lat_ms, 0.50), 3)
+            out["query_p95_ms"] = round(_percentile(lat_ms, 0.95), 3)
+            print(json.dumps({"stage": "query", **{
+                k: out[k] for k in ("query_n", "query_p50_ms",
+                                    "query_p95_ms")}}), flush=True)
+    finally:
+        if args.keep:
+            print(f"kept scratch: {work}", flush=True)
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+    print("INDEX_JSON " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -105,6 +105,26 @@ def cluster_argv(genomes: List[str], out_tsv: str, ckpt: str,
     return argv
 
 
+def index_argv(index_dir: str, genomes: Optional[List[str]] = None,
+               action: str = "insert", resume: bool = False,
+               report: Optional[str] = None) -> List[str]:
+    """`galah-tpu index` argv for the index-insert chaos workload.
+    --batch 2 keeps several durable safe boundaries inside one insert
+    so kills land between batches as well as inside them."""
+    argv = [sys.executable, "-m", "galah_tpu.cli", "index",
+            "--platform", "cpu", "--index-dir", index_dir]
+    if report:
+        argv += ["--run-report", report]
+    argv.append(action)
+    if genomes:
+        argv += ["--genome-fasta-files", *genomes]
+    if action == "insert":
+        argv += ["--batch", "2"]
+        if resume:
+            argv.append("--resume")
+    return argv
+
+
 def launch(argv: List[str], extra_env: Optional[Dict[str, str]] = None
            ) -> subprocess.Popen:
     env = dict(os.environ)
@@ -304,6 +324,194 @@ def run_iteration(genomes: List[str], reference: bytes, workdir: str,
 
 
 # ---------------------------------------------------------------------------
+# Index-insert workload
+# ---------------------------------------------------------------------------
+
+
+def index_dir_bytes(path: str) -> Dict[str, bytes]:
+    """Byte snapshot of an index directory, keyed by file name.
+
+    ``interruptions.jsonl`` is the one legitimately run-dependent file
+    (it records the kills themselves); everything else — logs,
+    generation manifests, commit pointer, fingerprint — must converge
+    to the uninterrupted reference byte for byte."""
+    out: Dict[str, bytes] = {}
+    for name in sorted(os.listdir(path)):
+        if name == "interruptions.jsonl":
+            continue
+        with open(os.path.join(path, name), "rb") as f:
+            out[name] = f.read()
+    return out
+
+
+def run_index_iteration(base_idx: str, new_genomes: List[str],
+                        reference: Dict[str, bytes], workdir: str,
+                        mode: str, seed: int,
+                        cache_env: Dict[str, str]) -> Tuple[bool, str]:
+    """One kill/resume iteration over `index insert`; (ok, detail).
+
+    Asserts the three index-insert chaos invariants: a kill at any
+    instant leaves the index fsck-clean and loadable at a committed
+    generation; a completed resume leaves zero .tmp debris; and the
+    converged directory is byte-identical to the uninterrupted insert
+    (modulo the interruption chain record)."""
+    from galah_tpu.index import store as index_store
+
+    work = os.path.join(workdir, f"ixiter_{seed}_{mode}")
+    os.makedirs(work, exist_ok=True)
+    idx = os.path.join(work, "idx")
+    shutil.copytree(base_idx, idx)
+    report = os.path.join(work, "report.json")
+    log: List[str] = []
+    rng = random.Random(f"chaos-index:{seed}:{mode}")
+
+    env = dict(cache_env)
+    env.update(fault_env(mode, seed) or {})
+    proc = launch(index_argv(idx, new_genomes, report=report), env)
+    if mode == "sigterm":
+        # the insert runs ~2-3 s end to end on the CPU backend; this
+        # window lands the signal mid-run most of the time while still
+        # exercising the landed-after-exit edge
+        time.sleep(rng.uniform(0.4, 2.2))
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+    try:
+        stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return False, f"{mode}: interrupted insert hung"
+    rc = proc.returncode
+    log.append(f"    interrupted insert exited {rc}")
+    interrupted = rc != 0
+    acceptable = {0, 1, EXIT_PREEMPTED, KILL_EXIT_CODE, -15,
+                  -signal.SIGKILL}
+    if rc not in acceptable:
+        return False, "\n".join(log + [
+            f"{mode}: unexpected exit {rc}",
+            stdout.decode(errors="replace")[-2000:]])
+
+    # invariant 1: whatever instant the kill landed, the index is
+    # loadable at a committed generation with zero fsck problems
+    # (uncommitted tails and tmp debris are expected warnings here)
+    rep = index_store.fsck(idx)
+    if rep["problems"]:
+        return False, "\n".join(log + [
+            f"{mode}: fsck problems after the kill:"] + rep["problems"])
+    if rep["generation"] not in (1, 2):
+        return False, "\n".join(log + [
+            f"{mode}: unexpected generation {rep['generation']} "
+            f"after the kill"])
+    log.append(f"    post-kill index loadable at generation "
+               f"{rep['generation']}")
+
+    for attempt in range(3):
+        if not interrupted:
+            break
+        proc = launch(index_argv(idx, new_genomes, resume=True,
+                                 report=report), cache_env)
+        try:
+            stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            return False, f"{mode}: resumed insert hung"
+        log.append(f"    resume attempt {attempt} exited "
+                   f"{proc.returncode}")
+        if proc.returncode == 0:
+            break
+        if attempt == 2:
+            return False, "\n".join(log + [
+                f"{mode}: resumed insert never completed "
+                f"(last exit {proc.returncode})",
+                stdout.decode(errors="replace")[-2000:]])
+
+    # invariant 2: a completed insert leaves no .tmp debris and every
+    # artifact readable through the recovery-aware readers
+    problems = scan_artifacts(idx)
+    if problems:
+        return False, "\n".join(log + [f"{mode}: corrupt artifacts:"]
+                                + problems)
+    rep = index_store.fsck(idx)
+    if not rep["ok"]:
+        return False, "\n".join(log + [f"{mode}: final fsck failed:"]
+                                + rep["problems"] + rep["warnings"])
+
+    # invariant 3: byte-identical convergence with the uninterrupted
+    # reference insert
+    got = index_dir_bytes(idx)
+    if got != reference:
+        diffs = sorted(set(got) ^ set(reference)) + [
+            n for n in sorted(set(got) & set(reference))
+            if got[n] != reference[n]]
+        return False, "\n".join(log + [
+            f"{mode}: converged index differs from the uninterrupted "
+            f"reference in: {diffs}"])
+    return True, "\n".join(log)
+
+
+def run_index_harness(iterations: int, seed: int, workdir: str,
+                      verbose: bool = True) -> int:
+    """Chaos loop over `index insert`; returns FAILED iteration count.
+
+    Builds the base index once (uninterrupted), computes the reference
+    insert on a copy, then kills/resumes the same insert on fresh
+    copies. The insert mixes joiners into existing clusters (each
+    family's last member) with a whole novel family (new
+    representatives), so kills land on both decision paths."""
+    gdir = os.path.join(workdir, "genomes")
+    os.makedirs(gdir, exist_ok=True)
+    genomes = make_workload(gdir, seed, families=3, members=4,
+                            length=12_000)
+    new = [genomes[3], genomes[7]] + genomes[8:]
+    base = [g for g in genomes if g not in new]
+    cache_env = {"GALAH_TPU_CACHE":
+                 os.path.join(workdir, "sketch_cache")}
+
+    base_idx = os.path.join(workdir, "base_idx")
+    proc = launch(index_argv(base_idx, base, action="build"), cache_env)
+    stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+    if proc.returncode != 0:
+        print("FATAL: index build failed:\n"
+              + stdout.decode(errors="replace")[-3000:])
+        return iterations or 1
+
+    ref_idx = os.path.join(workdir, "ref_idx")
+    shutil.copytree(base_idx, ref_idx)
+    proc = launch(index_argv(ref_idx, new), cache_env)
+    stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+    if proc.returncode != 0:
+        print("FATAL: reference insert failed:\n"
+              + stdout.decode(errors="replace")[-3000:])
+        return iterations or 1
+    reference = index_dir_bytes(ref_idx)
+    if verbose:
+        print(f"reference index: {len(reference)} files, "
+              f"{sum(len(b) for b in reference.values())} bytes")
+
+    rng = random.Random(seed)
+    schedule = [MODES[i % len(MODES)] for i in range(iterations)]
+    rng.shuffle(schedule)
+    failures = 0
+    for i, mode in enumerate(schedule):
+        ok, detail = run_index_iteration(
+            base_idx, new, reference, workdir, mode,
+            seed * 1000 + i, cache_env)
+        status = "PASS" if ok else "FAIL"
+        if verbose or not ok:
+            print(f"[{i + 1:2d}/{iterations}] index/{mode:<10s} "
+                  f"{status}")
+            for line in detail.splitlines():
+                if not ok or line.strip().startswith(
+                        ("interrupted", "resume", "post-kill")):
+                    print(f"      {line.strip()}")
+        failures += 0 if ok else 1
+    print(f"chaos[index]: {iterations - failures}/{iterations} "
+          f"iterations passed")
+    return failures
+
+
+# ---------------------------------------------------------------------------
 # Harness
 # ---------------------------------------------------------------------------
 
@@ -363,12 +571,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="scratch dir (default: a fresh tempdir)")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch dir for inspection")
+    ap.add_argument("--workload", default="cluster",
+                    choices=("cluster", "index-insert"),
+                    help="what to kill: a checkpointed cluster run "
+                         "(default) or an incremental `index insert` "
+                         "against a prebuilt index")
     args = ap.parse_args(argv)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="galah_chaos_")
     print(f"chaos scratch: {workdir}")
     try:
-        failures = run_harness(args.iterations, args.seed, workdir)
+        harness = (run_index_harness if args.workload == "index-insert"
+                   else run_harness)
+        failures = harness(args.iterations, args.seed, workdir)
     finally:
         if not args.keep and not args.workdir:
             shutil.rmtree(workdir, ignore_errors=True)

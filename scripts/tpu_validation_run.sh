@@ -172,6 +172,12 @@ run_stage sketch_variants 1200 python -u scripts/bench_sketch_variants.py
 # bench.py wedge and lands in its own artifact).
 run_stage ingest_variants 600 python -u scripts/bench_ingest.py \
   --variants --budget 480
+# Incremental-index service: build-once then insert-10% throughput
+# and the warm query-latency sweep (acceptance: p50 < 50 ms on CPU;
+# the TPU capture records the same numbers under the device sketch
+# path). Also runs inside bench.py; same wedge-survival rationale.
+run_stage index_service 300 python -u scripts/bench_index.py \
+  --budget 240
 run_stage ladder_tpu 3600 python -u scripts/ladder_bench.py --n 1000 \
   --genome-len 100000 --skip-rung1 --hash tpufast --ani-subsample 16
 

@@ -1,0 +1,325 @@
+"""Persistent sketch index: build/insert/query roundtrip, versioned
+generations, tombstone repair, fsck, and preemption/resume.
+
+The central claim under test is byte-identity (docs/index.md): an
+index grown by `insert` holds exactly the bytes a from-scratch `build`
+over the same quality order writes, and its re-derived clusters equal
+the cluster engine's output on the same corpus. Everything else —
+stale readers, local tombstone repair, fsck's problem/warning split,
+exit-75 preemption with `--resume` convergence, and the
+"resketch only the new genomes" counter — rides on that foundation.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from galah_tpu.backends import MinHashPreclusterer
+from galah_tpu.cluster import cluster
+from galah_tpu.index import incremental
+from galah_tpu.index.store import IndexStore, fsck
+from galah_tpu.io import diskcache
+from galah_tpu.resilience import interrupt
+
+BASES = np.array(list("ACGT"))
+
+
+def _write(path, codes, line=70):
+    seq = "".join(BASES[codes])
+    with open(path, "w") as f:
+        f.write(">contig1\n")
+        for i in range(0, len(seq), line):
+            f.write(seq[i:i + line] + "\n")
+
+
+def _dir_bytes(path):
+    """Committed-artifact bytes, keyed by name. interruptions.jsonl is
+    the one legitimately run-dependent file (it records the kills)."""
+    return {
+        name: open(os.path.join(path, name), "rb").read()
+        for name in sorted(os.listdir(path))
+        if name != "interruptions.jsonl"
+    }
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """4 planted families x 3 members (~0.5% within-family divergence)
+    plus three unrelated singletons for insert/query probes."""
+    root = tmp_path_factory.mktemp("index_corpus")
+    rng = np.random.default_rng(17)
+    length = 10_000
+    fams = []
+    for fam in range(4):
+        base = rng.integers(0, 4, size=length)
+        members = []
+        for m in range(3):
+            codes = base.copy()
+            if m:
+                sites = rng.random(length) < 0.005
+                codes[sites] = (codes[sites] + rng.integers(
+                    1, 4, size=int(sites.sum()))) % 4
+            p = str(root / f"fam{fam}_m{m}.fna")
+            _write(p, codes)
+            members.append(p)
+        fams.append(members)
+    extras = []
+    for i in range(3):
+        p = str(root / f"solo{i}.fna")
+        _write(p, rng.integers(0, 4, size=length))
+        extras.append(p)
+    return fams, extras
+
+
+@pytest.fixture(scope="module")
+def grown(corpus, tmp_path_factory):
+    """Build over 8 genomes, insert 4 (one family-joiner + one whole
+    new family) — the pristine incremental index every test copies."""
+    fams, _ = corpus
+    root = tmp_path_factory.mktemp("index_grown")
+    cache = str(root / "cache")
+    base = fams[0][:2] + fams[1] + fams[2]
+    inserted = [fams[0][2]] + fams[3]
+    idx_dir = str(root / "idx")
+    incremental.build(idx_dir, base, ani=0.95, precluster_ani=0.90,
+                      cache_dir=cache, threads=2)
+    info = incremental.insert(IndexStore(idx_dir), inserted,
+                              cache_dir=cache, threads=2)
+    assert info["inserted"] == 4
+    assert info["generation"] == 2
+    return {"idx": idx_dir, "cache": cache, "base": base,
+            "inserted": inserted, "full": base + inserted}
+
+
+def test_roundtrip_byte_identical_to_from_scratch(grown, tmp_path):
+    scratch = str(tmp_path / "scratch")
+    incremental.build(scratch, grown["full"], ani=0.95,
+                      precluster_ani=0.90, cache_dir=grown["cache"],
+                      threads=2)
+    got = _dir_bytes(grown["idx"])
+    want = _dir_bytes(scratch)
+    # the only sanctioned divergence: the grown index is at
+    # generation 2 and carries gen-000001.json from its build
+    del got["MANIFEST.json"], want["MANIFEST.json"]
+    gen2 = got.pop("gen-000002.json")
+    gen1 = got.pop("gen-000001.json")
+    want_gen1 = want.pop("gen-000001.json")
+    assert json.loads(gen2)["n_genomes"] == len(grown["full"])
+    assert got == want
+    # the grown decision state equals the from-scratch one exactly,
+    # generation number aside
+    g2, w1 = json.loads(gen2), json.loads(want_gen1)
+    del g2["generation"], w1["generation"]
+    assert g2 == w1
+    assert json.loads(gen1)["n_genomes"] == len(grown["base"])
+
+
+def test_clusters_match_engine(grown):
+    """The persisted decisions re-derive the cluster engine's exact
+    output (order included) on the same quality-ordered corpus."""
+    state = IndexStore(grown["idx"]).load()
+    pre = MinHashPreclusterer(
+        min_ani=0.90, cache=diskcache.get_cache(grown["cache"]),
+        threads=2)
+    engine = cluster(grown["full"], pre,
+                     incremental.SketchANIClusterer(0.95))
+    got = incremental.clusters_from_state(state)
+    assert [sorted(c) for c in got] == [sorted(c) for c in engine]
+    assert got == [list(c) for c in engine]
+
+
+def test_query_member_and_novel(grown, corpus):
+    _, extras = corpus
+    idx = IndexStore(grown["idx"])
+    state = idx.load()
+    joiner = grown["inserted"][0]  # fam0_m2 — a committed member
+    res = incremental.query(idx, [joiner, extras[2]],
+                            cache_dir=grown["cache"])
+    member, novel = res
+    assert member["decision"] == "member"
+    g = state.genomes.index(joiner)
+    assert member["rep"] == state.genomes[state.membership[g]]
+    assert member["ani"] >= 0.95
+    assert novel["decision"] == "novel"
+    assert novel["rep"] is None
+    # read-only: no generation bump, no new genome records
+    assert idx.generation() == 2
+    assert idx.reload().n_genomes == state.n_genomes
+
+
+def test_generation_bump_and_stale_reader(grown, corpus, tmp_path):
+    _, extras = corpus
+    d = str(tmp_path / "idx")
+    shutil.copytree(grown["idx"], d)
+    reader = IndexStore(d)
+    old = reader.load()
+    assert old.generation == 2
+    info = incremental.insert(IndexStore(d), [extras[0]],
+                              cache_dir=grown["cache"])
+    assert info["generation"] == 3
+    # the stale reader keeps serving its loaded generation until it
+    # explicitly reloads the commit pointer
+    assert reader.load().generation == 2
+    fresh = reader.reload()
+    assert fresh.generation == 3
+    assert fresh.n_genomes == old.n_genomes + 1
+
+
+def test_insert_skips_known_and_resketches_only_new(grown, corpus,
+                                                    tmp_path):
+    from galah_tpu.obs import metrics as obs_metrics
+
+    _, extras = corpus
+    d = str(tmp_path / "idx")
+    shutil.copytree(grown["idx"], d)
+
+    def computed():
+        snap = obs_metrics.snapshot().get("sketch.minhash_computed")
+        return int(snap["value"]) if snap else 0
+
+    before = computed()
+    info = incremental.insert(
+        IndexStore(d), [grown["inserted"][0], extras[0], extras[1]],
+        cache_dir=str(tmp_path / "coldcache"))
+    assert info["skipped"] == 1
+    assert info["inserted"] == 2
+    # a COLD cache dir, yet only the genuinely new genomes were
+    # sketched — known paths never reach the sketch stage at all
+    assert computed() - before == 2
+    # idempotence: replaying the same insert commits nothing
+    info = incremental.insert(
+        IndexStore(d), [grown["inserted"][0], extras[0], extras[1]],
+        cache_dir=str(tmp_path / "coldcache"))
+    assert info["inserted"] == 0
+    assert info["skipped"] == 3
+    assert info["generation"] == 3
+
+
+def test_remove_tombstone_and_reelection(grown, tmp_path):
+    d = str(tmp_path / "idx")
+    shutil.copytree(grown["idx"], d)
+    idx = IndexStore(d)
+    state = idx.load()
+    rep = next(r for r in state.reps
+               if sum(1 for v in state.membership.values()
+                      if v == r) >= 2)
+    members = sorted(g for g, v in state.membership.items() if v == rep)
+    info = incremental.remove(idx, state.genomes[rep])
+    assert info["removed"] == rep
+    assert info["reelected"] == members[0]
+    state = idx.load()
+    assert rep in state.tombstones
+    assert rep not in state.reps
+    assert members[0] in state.reps
+    for g in members[1:]:
+        assert state.membership[g] == members[0]
+    audit = fsck(d)
+    assert audit["ok"], audit["problems"]
+    assert audit["tombstones"] == 1
+    # removing a plain member just tombstones it
+    info = incremental.remove(idx, state.genomes[members[1]])
+    assert info["reelected"] is None
+    with pytest.raises(ValueError, match="not a live genome"):
+        incremental.remove(idx, state.genomes[rep])
+
+
+def test_fsck_truncated_and_flipped_records(grown, tmp_path):
+    # torn tail PAST the commit point: warning, still ok
+    d = str(tmp_path / "tail")
+    shutil.copytree(grown["idx"], d)
+    with open(os.path.join(d, "pairs.jsonl"), "ab") as f:
+        f.write(b'{"i": 0, "j": 99, "ani": 0.99}|deadbeef\n')
+    audit = fsck(d)
+    assert audit["ok"], audit["problems"]
+    assert any("torn" in w for w in audit["warnings"])
+
+    # truncation INSIDE the committed region: problem
+    d = str(tmp_path / "trunc")
+    shutil.copytree(grown["idx"], d)
+    fn = os.path.join(d, "sketches.jsonl")
+    size = os.path.getsize(fn)
+    with open(fn, "rb+") as f:
+        f.truncate(size // 2)
+    audit = fsck(d)
+    assert not audit["ok"]
+    assert any("sketches.jsonl" in p for p in audit["problems"])
+
+    # a single flipped byte in a committed record: the frame checksum
+    # rejects it, so the committed count comes up short — problem
+    d = str(tmp_path / "flip")
+    shutil.copytree(grown["idx"], d)
+    fn = os.path.join(d, "genomes.jsonl")
+    with open(fn, "rb") as f:
+        raw = bytearray(f.read())
+    mid = raw.index(b'"path"') + 10
+    raw[mid] ^= 0xFF
+    with open(fn, "wb") as f:
+        f.write(raw)
+    audit = fsck(d)
+    assert not audit["ok"]
+    assert any("genomes.jsonl" in p for p in audit["problems"])
+
+
+def test_cli_insert_preempted_then_resume_converges(grown, corpus,
+                                                    tmp_path):
+    """SIGTERM-style stop mid-insert: the CLI exits 75 with the index
+    loadable at the prior generation, and `--resume` completes to the
+    exact bytes an uninterrupted insert writes."""
+    from galah_tpu.cli import main
+    from galah_tpu.resilience.interrupt import EXIT_PREEMPTED
+
+    _, extras = corpus
+    d = str(tmp_path / "idx")
+    ref = str(tmp_path / "ref")
+    shutil.copytree(grown["idx"], d)
+    shutil.copytree(grown["idx"], ref)
+    incremental.insert(IndexStore(ref), extras[:2],
+                       cache_dir=grown["cache"])
+
+    orig = incremental.iter_insert_sketches
+
+    def tripping(paths, sk_store, threads=1):
+        for p, sk in orig(paths, sk_store, threads=threads):
+            yield p, sk
+            interrupt.request_stop("TEST")
+
+    argv = ["index", "--index-dir", d, "insert",
+            "-f", extras[0], extras[1],
+            "--sketch-cache", grown["cache"], "--batch", "1"]
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(incremental, "iter_insert_sketches", tripping)
+        try:
+            rc = main(argv)
+        finally:
+            interrupt.reset()
+    assert rc == EXIT_PREEMPTED
+    idx = IndexStore(d)
+    assert idx.generation() == 2  # still the pre-insert commit
+    assert idx.load_interruptions(), "preemption chain not recorded"
+    audit = fsck(d)
+    assert audit["ok"], audit["problems"]
+    assert any("uncommitted tail" in w for w in audit["warnings"])
+
+    try:
+        rc = main(argv + ["--resume"])
+    finally:
+        interrupt.reset()
+    assert rc == 0
+    assert IndexStore(d).generation() == 3
+    assert _dir_bytes(d) == _dir_bytes(ref)
+
+
+def test_build_refuses_param_drift(grown, tmp_path):
+    with pytest.raises(ValueError, match="already built"):
+        incremental.build(grown["idx"], grown["base"], ani=0.95,
+                          precluster_ani=0.90,
+                          cache_dir=grown["cache"])
+    with pytest.raises(ValueError, match="different parameters"):
+        incremental.build(grown["idx"], grown["base"], ani=0.97,
+                          precluster_ani=0.90,
+                          cache_dir=grown["cache"])
+    with pytest.raises(ValueError, match="no index at"):
+        IndexStore(str(tmp_path / "nothing"))

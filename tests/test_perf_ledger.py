@@ -184,7 +184,7 @@ def test_check_key_isolation_across_topologies():
 
 def _report(duration=12.0, n=256, extra_metrics=None):
     rep = {
-        "version": 4,
+        "version": 5,
         "run": {"subcommand": "cluster", "duration_s": duration},
         "device": {"backend": "cpu", "device_count": 1,
                    "devices": [{"device_kind": "cpu"}]},
