@@ -587,6 +587,50 @@ def _run_engine_rounds_stage(stages, errors):
         errors.append(f"engine_rounds: {type(e).__name__}: {e}")
 
 
+def _run_e2e_overlap_stage(stages, errors):
+    """Stage-serial vs fully overlapped dataflow on the e2e_1000 rung
+    in a subprocess (scripts/bench_overlap.py): the same planted-
+    family workload run once with GALAH_TPU_OVERLAP=0 (four sequential
+    drains) and once with the fused sketch -> pair-screen ->
+    speculative fragment-ANI -> eager greedy pipeline, with a cluster-
+    parity check, the overlap counters, and the per-stage
+    workload.pipeline_occupancy gauges in the payload. Same isolation
+    rationale as the variant matrices: self-budgeting script,
+    subprocess timeout."""
+    _OVERLAP_COST = 600
+    if not _admit(_OVERLAP_COST, "e2e_overlap", errors):
+        return
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "scripts", "bench_overlap.py"),
+             "--budget", str(_OVERLAP_COST - 30)],
+            capture_output=True, text=True,
+            timeout=_OVERLAP_COST, cwd=here)
+        data = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("OVERLAP_JSON "):
+                data = json.loads(line[len("OVERLAP_JSON "):])
+        if data is None:
+            raise RuntimeError(
+                f"rc={proc.returncode}: {proc.stderr[-400:]}")
+        stages["e2e_overlap"] = data
+        # Flatten the verdict numbers (rates, speedup, occupancy) to
+        # scalar stages so _finalize_obs mirrors them into
+        # run_report.json gauges alongside the ladder rungs.
+        for k in ("overlapped_genomes_per_sec",
+                  "serial_genomes_per_sec", "speedup"):
+            if isinstance(data.get(k), (int, float)):
+                stages[f"e2e_overlap_{k}"] = data[k]
+        for stage_name, v in (data.get("occupancy") or {}).items():
+            stages[f"e2e_overlap_occupancy_{stage_name}"] = v
+        for k, v in (data.get("counters") or {}).items():
+            stages[f"e2e_overlap_{k}"] = v
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"e2e_overlap: {type(e).__name__}: {e}")
+
+
 def _run_ingest_variants_stage(stages, errors):
     """Storage-bound ingest->sketch matrix in a subprocess
     (scripts/bench_ingest.py --variants): end-to-end Mbp/s by
@@ -888,6 +932,10 @@ def main():
             errors.append(f"cpu-pin: {type(e).__name__}: {e}")
         run_ladder_stages(stages, errors)
         _run_engine_rounds_stage(stages, errors)
+        # The overlapped-dataflow comparison is as real on the
+        # cpu-fallback branch as on the device one (the occupancy
+        # split documents how much of the win a 1-core host caps).
+        _run_e2e_overlap_stage(stages, errors)
         # Strategy matrix still recorded (interpret mode) so a
         # no-tunnel capture is a documented negative, not a silence.
         _run_pairlist_variants_stage(stages, errors, interpret=True)
@@ -957,6 +1005,11 @@ def main():
     # amortized campaign also runs standalone in the watcher).
     run_ladder_stages(stages, errors)
     _run_engine_rounds_stage(stages, errors)
+
+    # 4b'. Stage-serial vs fully overlapped dataflow on the same rung:
+    # parity gate + genomes/s for both schedules, plus the per-stage
+    # occupancy gauges that show where the pipeline sat busy.
+    _run_e2e_overlap_stage(stages, errors)
 
     # 4c. Amortized ON-CHIP kernel throughput (device-resident inputs,
     # fori_loop repeats inside one dispatch): the MFU measurement that

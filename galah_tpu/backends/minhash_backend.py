@@ -171,20 +171,24 @@ class MinHashPreclusterer(PreclusterBackend):
         index = {path: i for i, path in enumerate(unique)}
         return mat[[index[p] for p in genome_paths]]
 
-    def _streamed_pair_pass(self, genome_paths: Sequence[str]):
-        """Overlapped ingest->sketch->pair pass: consume the sketch
-        stream in row blocks and evaluate each block against all done
-        rows while the stream keeps ingesting ahead — no serial sketch
-        prologue. Engaged only where it is bit-identical to the staged
-        path AND the overlap can win: single process, unique paths,
-        below the sparse-screen crossover (the sparse pair pass needs
-        the full matrix up front), and a device sketch strategy (the
-        single-device-CPU C path keeps its historical shape).
-        Returns the pair dict, or None when not engaged."""
+    def distances_streamed(self, genome_paths: Sequence[str]):
+        """Overlapped ingest->sketch->pair pass as a STREAM: a
+        generator yielding `(r1, increment)` per arriving sketch block
+        (ops/pairwise.iter_threshold_pairs_streamed) — the pair
+        neighborhood of the prefix [0, r1) is complete at each yield,
+        which is what lets the overlapped cluster engine start greedy
+        rounds and speculative fragment-ANI while late genomes are
+        still being ingested and sketched. Engaged only where it is
+        bit-identical to the staged path AND the overlap can win:
+        single process, unique paths, below the sparse-screen
+        crossover (the sparse pair pass needs the full matrix up
+        front), and a device sketch strategy (the single-device-CPU C
+        path keeps its historical shape). Returns None when not
+        engaged."""
         import jax
 
         from galah_tpu.ops.collision import sparse_screen_min_n
-        from galah_tpu.ops.pairwise import threshold_pairs_streamed
+        from galah_tpu.ops.pairwise import iter_threshold_pairs_streamed
         from galah_tpu.ops.sketch_stream import (
             iter_sketch_row_blocks,
             resolve_sketch_strategy,
@@ -206,14 +210,31 @@ class MinHashPreclusterer(PreclusterBackend):
         logger.info(
             "Streaming %d genomes: ingest+sketch overlapped with the "
             "pair pass (strategy %s) ..", n, strategy)
-        with timing.stage("sketch-pairwise-streamed"):
-            # strategy=None: the stream re-resolves, preserving the
-            # explicit-pin vs AUTO failure semantics
-            blocks = iter_sketch_row_blocks(
-                genome_paths, self.store, threads=self.threads)
-            return threshold_pairs_streamed(
-                blocks, n, k=self.k, min_ani=self.min_ani,
-                sketch_size=self.sketch_size, mesh=mesh)
+
+        def gen():
+            with timing.stage("sketch-pairwise-streamed"):
+                # strategy=None: the stream re-resolves, preserving
+                # the explicit-pin vs AUTO failure semantics
+                blocks = iter_sketch_row_blocks(
+                    genome_paths, self.store, threads=self.threads)
+                for r1, inc in iter_threshold_pairs_streamed(
+                        blocks, n, k=self.k, min_ani=self.min_ani,
+                        sketch_size=self.sketch_size, mesh=mesh):
+                    yield r1, inc
+
+        return gen()
+
+    def _streamed_pair_pass(self, genome_paths: Sequence[str]):
+        """Drain `distances_streamed` into one pair dict (the
+        stage-serial consumer). Returns None when the streamed path is
+        not engaged."""
+        stream = self.distances_streamed(genome_paths)
+        if stream is None:
+            return None
+        out: dict = {}
+        for _r1, inc in stream:
+            out.update(inc)
+        return out
 
     def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
         pairs = self._streamed_pair_pass(genome_paths)

@@ -26,6 +26,7 @@ Semantics preserved:
 from __future__ import annotations
 
 import logging
+import time
 from typing import (TYPE_CHECKING, Dict, List, Optional, Sequence, Set,
                     Tuple)
 
@@ -39,6 +40,15 @@ from galah_tpu.resilience import interrupt
 from galah_tpu.utils import timing
 
 logger = logging.getLogger(__name__)
+
+# GL10xx pipeline-discipline contract (analysis/pipeline_check.py):
+# the overlapped dataflow consumes the precluster pair stream
+# incrementally and must report how busy it kept each downstream stage
+# (speculative fragment-ANI, eager greedy rounds).
+PIPELINE_STAGE = {
+    "streaming": ["_cluster_overlapped"],
+    "occupancy_gauge": "workload.pipeline_occupancy",
+}
 
 
 DENSE_PRECLUSTER_CAP = 64
@@ -147,6 +157,20 @@ def cluster(
             float(ingest_depth(int(ingest_threads))))
 
     pre_cache = checkpoint.load_distances() if checkpoint else None
+    overlap_state = None
+    if pre_cache is None:
+        # overlapped end-to-end dataflow (docs/dataflow.md): the
+        # streaming phase runs the pair pass, speculative fragment-ANI
+        # and eager greedy rounds together, quiescing before any
+        # durable write below
+        overlap_state = _maybe_cluster_overlapped(
+            genomes, preclusterer, clusterer, skip_clusterer,
+            checkpoint, rep_rounds)
+        if overlap_state is not None:
+            pre_cache = overlap_state.pre_cache
+            obs_profile.sample_memory("overlap-dataflow")
+            if checkpoint:
+                checkpoint.save_distances(pre_cache)
     if pre_cache is None:
         with timing.stage("precluster-distances"):
             pre_cache = preclusterer.distances(genomes)
@@ -176,7 +200,27 @@ def cluster(
         timing.counter(f"greedy-strategy-{strategy}", 1)
         pending = [(i, m) for i, m in enumerate(preclusters)
                    if i not in done]
-        if strategy == "device" and pending:
+        device_done: Optional[Dict[int, List[List[int]]]] = None
+        if overlap_state is not None and pending:
+            try:
+                device_done = _finish_overlapped(
+                    overlap_state, genomes, clusterer, pending,
+                    skip_clusterer, checkpoint)
+            except interrupt.PreemptionRequested:
+                raise  # a stop request is never a demotion signal
+            except Exception as e:  # noqa: BLE001 - AUTO demotes
+                if _overlap_mode() == "1":
+                    raise
+                logger.warning(
+                    "overlapped finish failed (%s: %s); falling back "
+                    "to the host scan", type(e).__name__, e)
+                timing.counter("overlap-demoted", 1)
+                from galah_tpu.obs import events
+
+                events.record("overlap-demoted",
+                              error=f"{type(e).__name__}: {e}")
+                device_done = None
+        elif strategy == "device" and pending:
             try:
                 device_done = _cluster_pending_rounds(
                     clusterer, genomes, pre_cache, pending,
@@ -195,15 +239,15 @@ def cluster(
                 events.record("greedy-demoted",
                               error=f"{type(e).__name__}: {e}")
                 device_done = None
-            if device_done is not None:
-                for pc_index, global_clusters in sorted(
-                        device_done.items()):
-                    if checkpoint:
-                        checkpoint.save_precluster(
-                            pc_index, global_clusters)
-                    done[pc_index] = global_clusters
+        if device_done is not None:
+            for pc_index, global_clusters in sorted(
+                    device_done.items()):
                 if checkpoint:
-                    checkpoint.clear_greedy_rounds()
+                    checkpoint.save_precluster(
+                        pc_index, global_clusters)
+                done[pc_index] = global_clusters
+            if checkpoint:
+                checkpoint.clear_greedy_rounds()
         for pc_index, members in enumerate(preclusters):
             if pc_index in done:
                 all_clusters.extend(done[pc_index])
@@ -421,6 +465,470 @@ def _greedy_digest(pending: List[Tuple[int, Sequence[int]]]) -> str:
 
     ident = json.dumps([[pc, list(m)] for pc, m in pending])
     return hashlib.sha256(ident.encode()).hexdigest()
+
+
+class _OverlapState:
+    """What the overlapped streaming phase hands to the post-quiesce
+    finish phase: the completed pair cache, the greedy decisions
+    already made over the arrived prefix, and the shared batch/value
+    closures so the membership pass reuses the same dedup + chunking
+    + waste accounting (docs/dataflow.md)."""
+
+    def __init__(self, n: int) -> None:
+        self.pre_cache = PairDistanceCache()
+        self.adj: Dict[int, List[int]] = {g: [] for g in range(n)}
+        self.ani_cache = PairDistanceCache()
+        self.computed: List[Tuple[int, int]] = []
+        self.consulted: Set[Tuple[int, int]] = set()
+        self.rep_order: List[int] = []
+        self.rep_set: Set[int] = set()
+        self.batch = None
+        self.value = None
+        self.eager_rounds = 0
+
+
+def _overlap_mode() -> str:
+    from galah_tpu.config import env_value
+
+    mode = (env_value("GALAH_TPU_OVERLAP") or "auto").strip().lower()
+    if mode not in ("auto", "0", "1"):
+        logger.warning("ignoring malformed GALAH_TPU_OVERLAP=%r "
+                       "(want auto/0/1)", mode)
+        return "auto"
+    return mode
+
+
+def _overlap_depth() -> int:
+    from galah_tpu.config import env_value
+
+    try:
+        return max(1, int(env_value("GALAH_TPU_OVERLAP_DEPTH") or 512))
+    except ValueError:
+        logger.warning("ignoring malformed GALAH_TPU_OVERLAP_DEPTH")
+        return 512
+
+
+def _maybe_cluster_overlapped(
+    genomes: Sequence[str],
+    preclusterer: PreclusterBackend,
+    clusterer: ClusterBackend,
+    skip_clusterer: bool,
+    checkpoint: Optional["ClusterCheckpoint"],
+    rep_rounds: Optional[int],
+) -> Optional[_OverlapState]:
+    """Run the overlapped end-to-end dataflow when it is engaged,
+    returning its state, or None for the stage-serial engine.
+
+    Engagement (GALAH_TPU_OVERLAP=auto/1) requires a fresh run — no
+    checkpointed distances or completed preclusters; a resume always
+    takes the stage-serial path, where the saved distance pass and the
+    greedy-round replay make the recompute free — plus a preclusterer
+    exposing `distances_streamed` that accepts the workload, and the
+    device greedy strategy (the eager rounds ARE device rounds).
+    Forced mode (=1) propagates ineligibility of the preclusterer/
+    strategy and any runtime failure; auto falls back to the
+    stage-serial engine from scratch (sketches are disk-cached, so the
+    retried prologue is cheap).
+    """
+    mode = _overlap_mode()
+    if mode == "0":
+        return None
+    forced = mode == "1"
+    if checkpoint and checkpoint.load_completed():
+        # a resume is stage-serial by design (see docstring), even
+        # when forced — this is ineligibility, not failure
+        return None
+    from galah_tpu.ops.greedy_select import resolve_greedy_strategy
+
+    strategy, _explicit = resolve_greedy_strategy()
+    if strategy != "device":
+        if forced:
+            raise RuntimeError(
+                "GALAH_TPU_OVERLAP=1 requires the device greedy "
+                f"strategy; GALAH_TPU_GREEDY_STRATEGY pins {strategy!r}")
+        return None
+    streamed = getattr(preclusterer, "distances_streamed", None)
+    stream = streamed(genomes) if streamed is not None else None
+    if stream is None:
+        if forced:
+            raise RuntimeError(
+                "GALAH_TPU_OVERLAP=1 but the precluster backend "
+                f"({preclusterer.method_name()}) did not engage its "
+                "streamed pair pass for this workload")
+        return None
+    try:
+        with timing.stage("overlap-dataflow"):
+            st = _cluster_overlapped(genomes, clusterer, stream,
+                                     skip_clusterer, rep_rounds)
+        timing.counter("overlap-engaged", 1)
+        return st
+    except interrupt.PreemptionRequested:
+        raise  # a stop request is never a demotion signal
+    except Exception as e:  # noqa: BLE001 - AUTO demotes
+        if forced:
+            raise
+        logger.warning(
+            "overlapped dataflow failed (%s: %s); falling back to the "
+            "stage-serial engine", type(e).__name__, e)
+        timing.counter("overlap-demoted", 1)
+        from galah_tpu.obs import events
+
+        events.record("overlap-demoted",
+                      error=f"{type(e).__name__}: {e}")
+        return None
+
+
+def _cluster_overlapped(
+    genomes: Sequence[str],
+    clusterer: ClusterBackend,
+    stream,
+    skip_clusterer: bool,
+    rep_rounds: Optional[int],
+) -> _OverlapState:
+    """Consume the streamed pair pass as ONE overlapped dataflow:
+    while the sketch stream's worker threads keep ingest+sketch
+    running ahead, this (consumer) thread interleaves three downstream
+    stages between block arrivals — the pair-screen stripes (inside
+    the stream generator), speculative fragment-ANI batches over
+    survivor pairs with a committed-rep endpoint, and eager greedy
+    rounds over the resolved prefix.
+
+    Frontier soundness (why eager decisions are bit-identical to the
+    stage-serial engine): genome g's rep decision consults exactly the
+    hit edges (i, g) with i < g and the rep status of those i. When
+    the stream has screened rows [0, r1), every such edge for every
+    g < r1 is known — the stripe covering block(j) evaluates rows
+    [0, r1) x cols [r0, r1) — so rep decisions over the prefix are
+    FINAL; no genome still being sketched can change them. Windows
+    therefore run at fixed absolute boundaries [0,w), [w,2w), ... as
+    soon as r1 reaches each window's end, grouped by the live
+    union-find component of the hit graph (a hit pair's endpoints are
+    already unioned when the edge arrives, so the current roots cover
+    every candidate edge a decision can consult). Membership and the
+    final cluster assembly wait for stream completion: a later rep
+    can still win a non-rep's argmax.
+
+    Speculation rule (zero extra waste): a survivor pair is offered to
+    the fragment-ANI buffer iff one endpoint is already a committed
+    rep — at edge arrival for the earlier endpoint, and via back-offer
+    when a window commits new reps. Every backend pair the greedy/
+    membership passes compute has a rep endpoint, so the offered set
+    is exactly the stage-serial computed set: the speculation moves
+    dispatches earlier, it never adds any. The buffer launches at
+    GALAH_TPU_OVERLAP_DEPTH pending pairs (bounded in-flight window,
+    memory O(depth)).
+
+    No durable write happens while the stream is live; the caller
+    quiesces (this function returns only once the stream is drained
+    and every window resolved) before `save_distances` and the single
+    greedy-round checkpoint record (_finish_overlapped).
+    """
+    import numpy as np
+
+    from galah_tpu.obs import metrics as obs_metrics
+    from galah_tpu.ops import greedy_select
+
+    thr = clusterer.ani_threshold
+    width = (int(rep_rounds) if rep_rounds is not None
+             else greedy_select.DEFAULT_ROUND_WIDTH)
+    if width < 1:
+        raise ValueError(f"rep_rounds must be >= 1, got {width}")
+    depth = _overlap_depth()
+    n = len(genomes)
+
+    st = _OverlapState(n)
+    pre_cache, adj = st.pre_cache, st.adj
+    ani_cache, computed = st.ani_cache, st.computed
+    consulted, rep_set = st.consulted, st.rep_set
+
+    # tiny union-find over arrived hit edges: current roots group the
+    # window genomes with every rep a candidate edge can reach
+    parent = list(range(n))
+
+    def find(x: int) -> int:
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    frag_busy = [0.0]   # batch-closure (fragment-ANI dispatch) wall
+    greedy_busy = [0.0]  # window wall net of nested fragment time
+
+    def batch(pairs: List[Tuple[int, int]]) -> None:
+        """Same dedup + ROUND_BATCH_GENOMES chunking as the
+        stage-serial batch closure (_cluster_pending_rounds), plus
+        fragment-stage busy accounting for the occupancy gauge."""
+        t0 = time.monotonic()
+        seen: Set[Tuple[int, int]] = set()
+        uniq: List[Tuple[int, int]] = []
+        for p in pairs:
+            k = pair_key(*p)
+            if k in seen or ani_cache.contains(p):
+                continue
+            seen.add(k)
+            uniq.append(p)
+        chunk: List[Tuple[int, int]] = []
+        chunk_genomes: Set[int] = set()
+
+        def flush() -> None:
+            if not chunk:
+                return
+            anis = _batch_ani(clusterer, skip_clusterer, pre_cache,
+                              genomes, chunk, None,
+                              computed_log=computed)
+            for p, ani in zip(chunk, anis):
+                ani_cache.insert(p, ani)
+            chunk.clear()
+            chunk_genomes.clear()
+
+        for p in uniq:
+            if chunk and len(chunk_genomes | set(p)) > \
+                    ROUND_BATCH_GENOMES:
+                flush()
+            chunk.append(p)
+            chunk_genomes.update(p)
+        flush()
+        frag_busy[0] += time.monotonic() - t0
+
+    def value(i: int, j: int) -> Optional[float]:
+        if skip_clusterer and pre_cache.contains((i, j)):
+            return pre_cache.get((i, j))
+        return ani_cache.get((i, j))
+
+    st.batch, st.value = batch, value
+
+    hist = obs_metrics.histogram(
+        "greedy.round_seconds",
+        help="Wall-clock of one device-strategy selection round "
+             "(speculative dispatch + frontier sub-rounds + jitted "
+             "window fold)",
+        unit="s")
+    rounds_c = obs_metrics.counter(
+        "greedy.rounds",
+        help="Device-strategy selection rounds run", unit="rounds")
+    conflicts_c = obs_metrics.counter(
+        "greedy.conflict_windows",
+        help="Round windows whose rep-chain depth exceeded the device "
+             "resolution budget", unit="windows")
+    fallback_c = obs_metrics.counter(
+        "greedy.fallback_windows",
+        help="Round windows finished by the exact host-order scan",
+        unit="windows")
+    eager_c = obs_metrics.counter(
+        "overlap.eager_rounds",
+        help="Greedy device rounds run while the sketch stream was "
+             "still producing (the overlapped engine's eager windows)",
+        unit="rounds")
+    spec_c = obs_metrics.counter(
+        "overlap.spec_pairs",
+        help="Survivor pairs offered to the speculative fragment-ANI "
+             "buffer", unit="pairs")
+
+    # speculative fragment-ANI buffer: survivor pairs with a committed
+    # rep endpoint, launched when `depth` accumulate
+    spec: List[Tuple[int, int]] = []
+    offered: Set[Tuple[int, int]] = set()
+    stats = {"offered": 0, "batches": 0, "peak": 0}
+
+    def flush_spec() -> None:
+        if not spec:
+            return
+        stats["batches"] += 1
+        batch(spec)
+        spec.clear()
+
+    def offer(pair: Tuple[int, int]) -> None:
+        k = pair_key(*pair)
+        if k in offered or ani_cache.contains(pair):
+            return
+        if skip_clusterer and pre_cache.contains(pair):
+            return  # precluster reuse — never hits the backend
+        offered.add(k)
+        spec.append(pair)
+        stats["offered"] += 1
+        stats["peak"] = max(stats["peak"], len(spec))
+        if len(spec) >= depth:
+            flush_spec()
+
+    frontier = [0]  # next undecided window start: prefix is FINAL
+
+    def run_ready_windows(r1: int) -> None:
+        while frontier[0] < n:
+            end = min(frontier[0] + width, n)
+            if r1 < end:
+                return
+            window = list(range(frontier[0], end))
+            t0 = time.monotonic()
+            fb0 = frag_busy[0]
+            pc_of = {g: find(g) for g in window}
+            reps_by_pc: Dict[int, List[int]] = {}
+            for r in st.rep_order:
+                reps_by_pc.setdefault(find(r), []).append(r)
+            for g in window:
+                reps_by_pc.setdefault(pc_of[g], [])
+            with hist.time():
+                _device_round(window, pc_of, adj, reps_by_pc, rep_set,
+                              batch, value, consulted, thr,
+                              greedy_select, np, conflicts_c,
+                              fallback_c)
+                timing.counter("greedy-rounds", 1)
+                rounds_c.inc()
+            timing.counter("overlap-eager-rounds", 1)
+            eager_c.inc()
+            st.eager_rounds += 1
+            # _device_round appends reps in window order; every window
+            # genome was undecided before, so the in-rep_set window
+            # genomes ARE this round's commits, in commit order
+            new_reps = [g for g in window if g in rep_set]
+            st.rep_order.extend(new_reps)
+            # back-offer: every hit pair of a fresh rep is one a later
+            # phase-1 candidate row or the membership argmax will read
+            for r in new_reps:
+                for t in adj[r]:
+                    offer((r, t))
+            frontier[0] = end
+            greedy_busy[0] += ((time.monotonic() - t0)
+                               - (frag_busy[0] - fb0))
+
+    t_start = time.monotonic()
+    for r1, inc in stream:
+        for (a, b), v in inc.items():
+            pre_cache.insert((a, b), v)
+            adj[a].append(b)
+            adj[b].append(a)
+            parent[find(a)] = find(b)
+            if a in rep_set:
+                offer((a, b))
+        run_ready_windows(r1)
+    if frontier[0] < n:
+        raise RuntimeError(
+            f"overlapped stream ended with the greedy frontier at "
+            f"{frontier[0]} of {n} genomes")
+    flush_spec()
+
+    timing.counter("overlap-spec-pairs", stats["offered"])
+    timing.counter("overlap-spec-batches", stats["batches"])
+    spec_c.inc(stats["offered"])
+    obs_metrics.gauge(
+        "overlap.spec_pending_peak",
+        help="High-water mark of the speculative fragment-ANI buffer "
+             "(bounded by GALAH_TPU_OVERLAP_DEPTH)",
+        unit="pairs").set(float(stats["peak"]))
+
+    # per-stage occupancy over the streaming phase's wall, plus the
+    # whole-pipeline value (mean of the per-stage gauges this run
+    # emitted) as the unlabelled gauge
+    wall = max(time.monotonic() - t_start, 1e-9)
+    obs_metrics.pipeline_occupancy(greedy_busy[0] / wall,
+                                   stage="greedy")
+    if not skip_clusterer:
+        obs_metrics.pipeline_occupancy(frag_busy[0] / wall,
+                                       stage="fragment")
+    prefix = obs_metrics.PIPELINE_OCCUPANCY_GAUGE + "["
+    vals = [m["value"] for name, m in obs_metrics.snapshot().items()
+            if name.startswith(prefix) and m.get("value") is not None]
+    if vals:
+        obs_metrics.pipeline_occupancy(sum(vals) / len(vals))
+    return st
+
+
+def _finish_overlapped(
+    st: _OverlapState,
+    genomes: Sequence[str],
+    clusterer: ClusterBackend,
+    pending: List[Tuple[int, Sequence[int]]],
+    skip_clusterer: bool,
+    checkpoint: Optional["ClusterCheckpoint"],
+) -> Dict[int, List[List[int]]]:
+    """Post-quiesce finish of the overlapped dataflow: persist every
+    overlap-computed ANI as ONE digest-bound greedy-round record (a
+    kill after this boundary resumes stage-serial and replays them
+    with zero dispatches), then run the membership pass and per-
+    precluster assembly exactly as the stage-serial device strategy
+    does — decisions were already made during streaming."""
+    import numpy as np
+
+    from galah_tpu.ops import greedy_select
+
+    pc_of: Dict[int, int] = {}
+    for pc, members in pending:
+        for g in members:
+            pc_of[g] = pc
+    reps_by_pc: Dict[int, List[int]] = {pc: [] for pc, _ in pending}
+    for r in st.rep_order:
+        if r in pc_of:
+            reps_by_pc[pc_of[r]].append(r)
+
+    digest = _greedy_digest(pending)
+    if checkpoint and st.computed:
+        checkpoint.save_greedy_round(
+            digest,
+            [(i, j, st.ani_cache.get((i, j))) for i, j in st.computed])
+    # safe boundary: the streaming phase's ANI pairs are durable — a
+    # stage-serial resume replays them and re-derives every greedy
+    # decision for free
+    interrupt.check("greedy-round-saved")
+
+    # -- membership: one global batched dispatch + jitted argmax ------
+    todo: List[Tuple[int, int]] = []
+    for a, b in st.pre_cache.keys():
+        if a not in pc_of:
+            continue
+        a_rep, b_rep = a in st.rep_set, b in st.rep_set
+        if a_rep == b_rep:
+            continue  # rep-rep / non-rep pairs never decide membership
+        r, i = (a, b) if a_rep else (b, a)
+        if not (skip_clusterer and st.pre_cache.contains((i, r))) \
+                and not st.ani_cache.contains((i, r)):
+            todo.append((r, i))
+    todo.sort(key=lambda p: (p[1], p[0]))
+    n_rep_computed = len(st.computed)
+    st.batch(todo)
+
+    results: Dict[int, List[List[int]]] = {}
+    for pc, members in pending:
+        rep_list = reps_by_pc[pc]
+        rep_col = {r: c for c, r in enumerate(rep_list)}
+        nonreps = [g for g in members if g not in st.rep_set]
+        clusters: List[List[int]] = [[r] for r in rep_list]
+        if nonreps:
+            mat = np.full((len(nonreps), len(rep_list)), np.nan,
+                          dtype=np.float64)
+            for gi, g in enumerate(nonreps):
+                for r in st.adj[g]:
+                    c = rep_col.get(r)
+                    if c is None:
+                        continue
+                    v = st.value(g, r)
+                    if v is not None:
+                        mat[gi, c] = v
+            best, has = greedy_select.membership_argmax(mat)
+            for gi, g in enumerate(nonreps):
+                if not has[gi]:
+                    raise RuntimeError(
+                        f"genome {genomes[g]} passed the representative "
+                        "test but has no ANI to any representative — "
+                        "inconsistent backend")
+                clusters[int(best[gi])].append(g)
+        results[pc] = clusters
+
+    # -- waste accounting, split by paying phase ----------------------
+    computed_keys = {pair_key(*p) for p in st.computed}
+    mem_consulted = {k for k in computed_keys
+                     if (k[0] in st.rep_set) != (k[1] in st.rep_set)}
+    live = st.consulted | mem_consulted
+    rep_keys = {pair_key(*p) for p in st.computed[:n_rep_computed]}
+    mem_keys = {pair_key(*p) for p in st.computed[n_rep_computed:]} \
+        - rep_keys
+    _emit_waste_counters(
+        len(computed_keys),
+        rep=len(rep_keys - live),
+        membership=len(mem_keys - live),
+        warm=0,
+        label=f"overlapped rounds ({len(pending)} preclusters)")
+    return results
 
 
 def _cluster_pending_rounds(

@@ -167,6 +167,21 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
          help="Look-ahead depth of the streaming ingest stage (parsed "
               "genomes in flight ahead of the sketch launches); unset "
               "uses max(2, threads)"),
+    Flag("GALAH_TPU_OVERLAP", section="kernel", default="auto",
+         choices=("auto", "0", "1"),
+         help="Overlapped end-to-end dataflow (docs/dataflow.md): "
+              "sketch -> pair screen -> speculative fragment-ANI -> "
+              "eager greedy rounds run as one pipeline instead of "
+              "four sequential drains. auto engages it where it is "
+              "bit-identical to the stage-serial engine and demotes "
+              "on failure; 1 forces it (failures propagate); 0 "
+              "disables it"),
+    Flag("GALAH_TPU_OVERLAP_DEPTH", kind="int", default="512",
+         section="kernel",
+         help="Survivor pairs buffered before a speculative "
+              "fragment-ANI batch launches in the overlapped "
+              "dataflow; bounds the in-flight window (memory stays "
+              "O(depth))"),
     Flag("GALAH_TPU_PALLAS_HASH", kind="bool", section="kernel",
          help="1 forces the quarantined Mosaic murmur3 kernel, 0 "
               "forces the XLA u64 emulation; unset uses the "

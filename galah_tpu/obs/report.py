@@ -31,6 +31,9 @@ REPORT_VERSION = 5
 # disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
 _DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
 _RETRY_RE = re.compile(r"^retries\[(.*)\]$")
+# workload.pipeline_occupancy[<stage>] gauges (obs/metrics.py); the
+# unlabelled gauge is the whole-pipeline value
+_OCC_RE = re.compile(r"^workload\.pipeline_occupancy(?:\[(.*)\])?$")
 
 
 def flag_snapshot() -> Dict[str, dict]:
@@ -253,6 +256,27 @@ def _render_stage_tree(tree: List[dict], indent: int = 2) -> List[str]:
     return out
 
 
+def _occupancy_rows(metrics: Dict[str, dict]) -> List[Tuple[str, float]]:
+    """(stage, occupancy) rows from the metrics snapshot, per-stage
+    gauges first, the unlabelled whole-pipeline value last."""
+    rows: List[Tuple[str, float]] = []
+    whole: Optional[float] = None
+    for name, m in sorted(metrics.items()):
+        mm = _OCC_RE.match(name)
+        if not mm:
+            continue
+        v = m.get("value")
+        if v is None:
+            continue
+        if mm.group(1):
+            rows.append((mm.group(1), float(v)))
+        else:
+            whole = float(v)
+    if whole is not None:
+        rows.append(("pipeline", whole))
+    return rows
+
+
 def render(report: dict) -> str:
     """One human-readable page per report."""
     run = report.get("run", {})
@@ -298,6 +322,15 @@ def render(report: dict) -> str:
         f"{cache.get('misses', 0)} misses"
         + (f" ({100.0 * hit_rate:.0f}% hit rate)"
            if hit_rate is not None else ""),
+    ]
+    occ = _occupancy_rows(report.get("metrics", {}))
+    if occ:
+        lines += ["", "pipeline occupancy (busy fraction of stage "
+                      "wall; 1.0 = never starved):"]
+        for stage, v in occ:
+            bar = "#" * int(round(max(0.0, min(1.0, v)) * 20))
+            lines.append(f"  {stage:<10} {v:5.2f} {bar}")
+    lines += [
         "",
         "resilience:",
         f"  retries:    {res.get('retries', {}) or 'none'}",
@@ -454,6 +487,16 @@ def diff(a: dict, b: dict, label_a: str = "A",
                 "exact_ani_computed", "exact_ani_wasted"):
         va, vb = fa.get(key, 0), fb.get(key, 0)
         lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
+
+    oa = dict(_occupancy_rows(a.get("metrics", {})))
+    ob = dict(_occupancy_rows(b.get("metrics", {})))
+    if oa or ob:
+        lines += ["", "pipeline occupancy:"]
+        for stage in sorted(set(oa) | set(ob)):
+            va, vb = oa.get(stage), ob.get(stage)
+            delta = ("" if va is None or vb is None
+                     else f" ({vb - va:+.2f})")
+            lines.append(f"  {stage}: {va} -> {vb}{delta}")
 
     lines += ["", "per-metric deltas:"]
     ma = a.get("metrics", {})

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -37,6 +38,14 @@ from galah_tpu.ops.hashing import HASH_SENTINEL
 from galah_tpu.utils import timing
 
 jax.config.update("jax_enable_x64", True)
+
+# GL10xx pipeline-discipline contract (analysis/pipeline_check.py): the
+# streamed pair pass must never be eagerly materialized and must report
+# how busy it kept the device between block arrivals.
+PIPELINE_STAGE = {
+    "streaming": ["iter_threshold_pairs_streamed"],
+    "occupancy_gauge": "workload.pipeline_occupancy",
+}
 
 
 def _pair_stats(a: jax.Array, b: jax.Array,
@@ -666,7 +675,7 @@ def _stripe_stats(rows_mat: jax.Array, cols_mat: jax.Array,
     return c.reshape(n_rt * row_tile, b), t.reshape(n_rt * row_tile, b)
 
 
-def threshold_pairs_streamed(
+def iter_threshold_pairs_streamed(
     blocks_iter,
     n: int,
     k: int,
@@ -675,24 +684,33 @@ def threshold_pairs_streamed(
     mesh: "Optional[Mesh]" = None,
     block: int = 256,
     row_tile: int = 64,
-) -> dict[tuple[int, int], float]:
-    """`threshold_pairs` over an ARRIVING sketch stream: consume
-    (r0, rows) blocks (ops/sketch_stream.iter_sketch_row_blocks) and
-    evaluate each block against every row seen so far while the stream
-    keeps ingesting ahead — the pair pass overlaps ingest+sketch
-    instead of waiting for the full matrix.
+):
+    """Streamed pair pass as a GENERATOR: consume (r0, rows) sketch
+    blocks (ops/sketch_stream.iter_sketch_row_blocks) and, per block,
+    yield `(r1, increment)` where `increment` maps surviving (i, j)
+    pairs with j < r1 that were first resolvable on this stripe. The
+    union of all increments is IDENTICAL to
+    `threshold_pairs(full_matrix, ...)` by construction: every i<j
+    pair is covered exactly once (rows [0, r1) x cols [r0, r1),
+    filtered to i < j), and the exact f64 integer-Jaccard check runs
+    on host over the integer stats.
 
-    Every i<j pair is covered exactly once (as a stripe entry when
-    block(j) arrives: rows [0, r0+b) x cols [r0, r0+b), filtered to
-    i < j), and the exact f64 integer-Jaccard check runs on host over
-    the integer stats — so the result dict is IDENTICAL to
-    `threshold_pairs(full_matrix, ...)` by construction. Done-row
-    counts are padded to powers of two (>= the tiling quantum) to
-    bound the jit variants at O(log n); sentinel padding rows/cols are
-    killed by the `common > 0` guard (a sentinel row intersects
-    nothing). With a multi-device `mesh`, each stripe is computed with
-    rows sharded over the mesh (parallel/mesh.sharded_stripe_stats) —
-    bit-identical integers either way.
+    Yielding per block is what lets a downstream consumer (the
+    overlapped cluster engine) act on the prefix [0, r1) — whose pair
+    neighborhood is COMPLETE at that point — while later genomes are
+    still being ingested and sketched.
+
+    Done-row counts are padded to powers of two (>= the tiling
+    quantum) to bound the jit variants at O(log n); sentinel padding
+    rows/cols are killed by the `common > 0` guard (a sentinel row
+    intersects nothing). With a multi-device `mesh`, each stripe is
+    computed with rows sharded over the mesh
+    (parallel/mesh.sharded_stripe_stats) — bit-identical integers
+    either way.
+
+    Emits the stage="pairs" `workload.pipeline_occupancy` gauge on
+    exhaustion: the fraction of this stage's wall spent working (vs
+    blocked waiting on the upstream sketch stream).
     """
     j_thr = ani_to_jaccard(min_ani, k)
     n_dev = mesh.devices.size if mesh is not None else 1
@@ -704,10 +722,18 @@ def threshold_pairs_streamed(
 
     done = np.full((n, sketch_size), np.uint64(SENTINEL),
                    dtype=np.uint64)
-    out: dict[tuple[int, int], float] = {}
     r1 = 0
     stripes = 0
-    for r0, rows in blocks_iter:
+    t_start = time.monotonic()
+    wait_s = 0.0
+    blocks = iter(blocks_iter)
+    while True:
+        t0 = time.monotonic()
+        try:
+            r0, rows = next(blocks)
+        except StopIteration:
+            break
+        wait_s += time.monotonic() - t0
         bsz = rows.shape[0]
         assert r0 == r1, f"streamed blocks out of order: {r0} != {r1}"
         done[r0:r0 + bsz] = rows
@@ -750,11 +776,41 @@ def threshold_pairs_streamed(
                 & (common.astype(np.float64) >= j_thr * total))
         ki, kj = np.nonzero(keep)
         ani = stats_to_ani_f64(common[keep], total[keep], k)
+        inc: dict[tuple[int, int], float] = {}
         for a, b, v in zip(ki.tolist(), (r0 + kj).tolist(),
                            ani.tolist()):
-            out[(int(a), int(b))] = float(v)
+            inc[(int(a), int(b))] = float(v)
+        yield r1, inc
     if r1 != n:
         raise ValueError(
             f"streamed pair pass saw {r1} rows, expected {n}")
     timing.counter("pairs-streamed-stripes", stripes)
+    wall = time.monotonic() - t_start
+    if wall > 0 and stripes:
+        from galah_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.pipeline_occupancy(1.0 - wait_s / wall,
+                                       stage="pairs")
+
+
+def threshold_pairs_streamed(
+    blocks_iter,
+    n: int,
+    k: int,
+    min_ani: float,
+    sketch_size: int,
+    mesh: "Optional[Mesh]" = None,
+    block: int = 256,
+    row_tile: int = 64,
+) -> dict[tuple[int, int], float]:
+    """`threshold_pairs` over an ARRIVING sketch stream — drains
+    `iter_threshold_pairs_streamed` into one dict. The result is
+    IDENTICAL to `threshold_pairs(full_matrix, ...)`; see the
+    generator's docstring for the exactness argument."""
+    out: dict[tuple[int, int], float] = {}
+    for _r1, inc in iter_threshold_pairs_streamed(
+            blocks_iter, n, k=k, min_ani=min_ani,
+            sketch_size=sketch_size, mesh=mesh, block=block,
+            row_tile=row_tile):
+        out.update(inc)
     return out

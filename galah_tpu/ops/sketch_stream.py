@@ -477,8 +477,19 @@ def iter_path_sketches(
     t0 = time.monotonic()
     bp_total = 0
 
+    # per-read ingest wall, appended from the prefetch workers (list
+    # append is atomic); its sum over the stage wall is the ingest
+    # stage's occupancy gauge
+    ingest_s: list = []
+
+    def _timed_ingest(path):
+        ti = time.monotonic()
+        g = _ingest_read(path)
+        ingest_s.append(time.monotonic() - ti)
+        return g
+
     hits, miss_iter = probe_and_prefetch(
-        paths, store.get_cached, _ingest_read,
+        paths, store.get_cached, _timed_ingest,
         depth=ingest_depth(threads))
 
     def counting(it):
@@ -547,7 +558,14 @@ def iter_path_sketches(
             "workload.ingest_mbp_s",
             help="end-to-end ingest+sketch throughput of the streaming "
                  "sketch stage", unit="Mbp/s").set(bp_total / 1e6 / wall)
-        obs_metrics.pipeline_occupancy(1.0 - wait_s / wall)
+        occ = 1.0 - wait_s / wall
+        # the unlabelled gauge keeps its historical meaning (this
+        # stage's occupancy) until the overlapped engine overwrites it
+        # with the whole-pipeline mean at quiesce (cluster/engine.py)
+        obs_metrics.pipeline_occupancy(occ)
+        obs_metrics.pipeline_occupancy(occ, stage="sketch")
+        obs_metrics.pipeline_occupancy(sum(ingest_s) / wall,
+                                       stage="ingest")
 
 
 def iter_sketch_row_blocks(

@@ -1,0 +1,168 @@
+"""Stage-serial vs fully overlapped end-to-end dataflow on the
+e2e_1000 rung.
+
+The overlapped engine (cluster/engine.py::_cluster_overlapped) fuses
+sketch -> pair-screen -> speculative fragment-ANI -> eager greedy
+rounds into one pipeline; this stage prices exactly that against the
+stage-serial drain on the SAME workload the bench ladder's e2e_1000
+rung runs (1000 synthetic genomes, 250 planted families x4, 3%
+mutation, 100 kbp), end to end through
+``generate_galah_clusterer(...).cluster()``:
+
+  * overlapped: GALAH_TPU_OVERLAP=1, run FIRST so its jit compiles
+    land inside its own timing (conservative for the speedup claim);
+  * serial: GALAH_TPU_OVERLAP=0, the four-drain baseline;
+  * parity: the two clusterings must be IDENTICAL — the overlap is a
+    scheduling change, not an algorithm change, so a parity failure
+    zeroes the speedup field and is reported.
+
+Both runs pin GALAH_TPU_SKETCH_STRATEGY=xla (single-device CPU hosts
+AUTO-resolve to the C sketcher, whose batch delivery disengages the
+stream — the comparison must run the same sketcher either way) and
+GALAH_TPU_GREEDY_STRATEGY=device (the overlap requires the round-based
+device scan; pinning it for the serial run keeps the runs twins).
+
+The payload carries the overlap counters (engaged / eager rounds /
+speculative pairs and batches / demotions) and the per-stage
+``workload.pipeline_occupancy[...]`` gauges for the overlapped run, so
+a capture shows not just the rate but WHERE the pipeline sat busy vs
+starved — on a 1-core host the wall-clock win is capped by the serial
+CPU fraction and the occupancy split is the evidence of TPU-side
+headroom.
+
+Self-budgeting like the variant matrices: under a tight --budget the
+workload downshifts to a 200-genome rung (recorded in `workload`), and
+a partial run still prints OVERLAP_JSON with what it measured.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_T0 = time.monotonic()
+
+# Overlap bookkeeping copied into the payload (deltas across the timed
+# overlapped run).
+_COUNTERS = ("overlap-engaged", "overlap-eager-rounds",
+             "overlap-spec-pairs", "overlap-spec-batches",
+             "overlap-demoted", "greedy-rounds",
+             "greedy-host-fallback-windows")
+
+_VALUES = {"ani": 95.0, "precluster_ani": 90.0,
+           "min_aligned_fraction": 15.0, "fragment_length": 3000,
+           "precluster_method": "finch", "cluster_method": "skani",
+           "threads": 1}
+
+# Pinned for BOTH runs — see the module docstring.
+_PINS = {"GALAH_TPU_SKETCH_STRATEGY": "xla",
+         "GALAH_TPU_GREEDY_STRATEGY": "device"}
+
+
+def _left(budget):
+    return budget - (time.monotonic() - _T0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=None,
+                    help="seconds for the whole stage (default 570, "
+                         "capped by GALAH_BENCH_STAGE_CAP)")
+    args = ap.parse_args()
+
+    budget = args.budget if args.budget is not None else 570.0
+    cap = os.environ.get("GALAH_BENCH_STAGE_CAP")
+    if cap:
+        budget = min(budget, float(cap))
+
+    from bench import _synth_families
+    from galah_tpu.api import generate_galah_clusterer
+    from galah_tpu.obs import metrics as obs_metrics
+    from galah_tpu.utils import timing
+
+    # The full rung costs ~2x the e2e wall (two complete runs); under
+    # a tight budget downshift rather than print nothing.
+    if _left(budget) >= 240:
+        n_genomes, n_families = 1000, 250
+    else:
+        n_genomes, n_families = 200, 50
+    paths = _synth_families(n_genomes=n_genomes, genome_len=100_000,
+                            n_families=n_families, mut=0.03, seed=11)
+
+    out = {
+        "workload": f"{n_genomes} synthetic genomes, {n_families} "
+                    "planted families x4, 3% mutation, 100 kbp, "
+                    "murmur3 finch+skani, xla sketcher",
+        "n_genomes": n_genomes,
+        "skipped": [],
+    }
+    clusterings = {}
+
+    def run_one(mode):
+        env_saved = {k: os.environ.get(k)
+                     for k in ("GALAH_TPU_OVERLAP", *_PINS)}
+        os.environ["GALAH_TPU_OVERLAP"] = \
+            "1" if mode == "overlapped" else "0"
+        os.environ.update(_PINS)
+        obs_metrics.reset()  # per-run occupancy gauges
+        try:
+            before = timing.GLOBAL.counters()
+            t0 = time.perf_counter()
+            clusterer = generate_galah_clusterer(list(paths),
+                                                 dict(_VALUES))
+            clusters = clusterer.cluster()
+            dt = time.perf_counter() - t0
+            after = timing.GLOBAL.counters()
+        finally:
+            for k, v in env_saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        clusterings[mode] = clusters
+        out[f"{mode}_genomes_per_sec"] = round(len(paths) / dt, 2)
+        out[f"{mode}_seconds"] = round(dt, 3)
+        out[f"{mode}_n_clusters"] = len(clusters)
+        if mode == "overlapped":
+            out["counters"] = {
+                k: after.get(k, 0) - before.get(k, 0)
+                for k in _COUNTERS
+                if after.get(k, 0) - before.get(k, 0)}
+            occ = {}
+            for name, snap in obs_metrics.snapshot().items():
+                if name.startswith("workload.pipeline_occupancy"):
+                    stage = (name.split("[", 1)[1].rstrip("]")
+                             if "[" in name else "pipeline")
+                    occ[stage] = round(snap.get("value", 0.0), 3)
+            out["occupancy"] = occ
+            out["engaged"] = bool(
+                out["counters"].get("overlap-engaged"))
+
+    # Overlapped first: its compiles are billed to it.
+    for mode in ("overlapped", "serial"):
+        if _left(budget) < 30:
+            out["skipped"].append(mode)
+            continue
+        try:
+            run_one(mode)
+        except Exception as e:  # noqa: BLE001 - partial JSON > crash
+            out[f"{mode}_error"] = f"{type(e).__name__}: {e}"
+
+    if "overlapped" in clusterings and "serial" in clusterings:
+        out["parity"] = clusterings["overlapped"] == clusterings["serial"]
+        if out["parity"] and out.get("serial_genomes_per_sec"):
+            out["speedup"] = round(
+                out["overlapped_genomes_per_sec"]
+                / out["serial_genomes_per_sec"], 2)
+        elif not out["parity"]:
+            out["speedup"] = 0.0
+
+    print("OVERLAP_JSON " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -91,11 +91,12 @@ def make_workload(root: str, seed: int, families: int = 2,
 
 
 def cluster_argv(genomes: List[str], out_tsv: str, ckpt: str,
-                 report: str, resume: bool) -> List[str]:
+                 report: str, resume: bool,
+                 precluster: str = "skani") -> List[str]:
     argv = [sys.executable, "-m", "galah_tpu.cli", "cluster",
             "--platform", "cpu",
             "--genome-fasta-files", *genomes,
-            "--precluster-method", "skani",
+            "--precluster-method", precluster,
             "--cluster-method", "skani",
             "--output-cluster-definition", out_tsv,
             "--checkpoint-dir", ckpt,
@@ -103,6 +104,18 @@ def cluster_argv(genomes: List[str], out_tsv: str, ckpt: str,
     if resume:
         argv.append("--resume")
     return argv
+
+
+#: Env for the cluster-overlap workload: force the overlapped dataflow
+#: (any engagement failure is then a loud error, not a silent demote)
+#: and pin the XLA sketcher — single-device CPU hosts AUTO-resolve to
+#: the C sketcher, whose sketches arrive as one batch rather than a
+#: stream, which disengages the overlap. A resumed run reloads saved
+#: distances and quietly runs stage-serial by design, so the same env
+#: is safe on every launch in the kill/resume chain.
+OVERLAP_ENV = {"GALAH_TPU_OVERLAP": "1",
+               "GALAH_TPU_SKETCH_STRATEGY": "xla",
+               "GALAH_TPU_GREEDY_STRATEGY": "device"}
 
 
 def index_argv(index_dir: str, genomes: Optional[List[str]] = None,
@@ -202,7 +215,9 @@ def fault_env(mode: str, seed: int) -> Optional[Dict[str, str]]:
 
 
 def run_one(genomes: List[str], work: str, mode: str, seed: int,
-            log: List[str]) -> Tuple[bool, str]:
+            log: List[str], precluster: str = "skani",
+            extra_env: Optional[Dict[str, str]] = None
+            ) -> Tuple[bool, str]:
     """One kill/resume iteration; returns (ok, detail)."""
     rng = random.Random(f"chaos:{seed}:{mode}")
     ckpt = os.path.join(work, "ckpt")
@@ -210,8 +225,11 @@ def run_one(genomes: List[str], work: str, mode: str, seed: int,
     report = os.path.join(work, "report.json")
 
     # -- interrupted run ------------------------------------------------
+    env = dict(extra_env or {})
+    env.update(fault_env(mode, seed) or {})
     proc = launch(cluster_argv(genomes, out_tsv, ckpt, report,
-                               resume=False), fault_env(mode, seed))
+                               resume=False, precluster=precluster),
+                  env)
     if mode == "sigterm":
         # the workload runs ~2-3 s end to end (measured on the CPU
         # backend); this window lands the signal mid-run most of the
@@ -244,7 +262,8 @@ def run_one(genomes: List[str], work: str, mode: str, seed: int,
         can_resume = os.path.exists(
             os.path.join(ckpt, "fingerprint.json"))
         proc = launch(cluster_argv(genomes, out_tsv, ckpt, report,
-                                   resume=can_resume))
+                                   resume=can_resume,
+                                   precluster=precluster), extra_env)
         try:
             stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
         except subprocess.TimeoutExpired:
@@ -292,11 +311,14 @@ def check_report(report_path: str, ckpt: str, was_preempted: bool
 
 
 def run_iteration(genomes: List[str], reference: bytes, workdir: str,
-                  mode: str, seed: int) -> Tuple[bool, str]:
+                  mode: str, seed: int, precluster: str = "skani",
+                  extra_env: Optional[Dict[str, str]] = None
+                  ) -> Tuple[bool, str]:
     work = os.path.join(workdir, f"iter_{seed}_{mode}")
     os.makedirs(work, exist_ok=True)
     log: List[str] = []
-    ok, detail = run_one(genomes, work, mode, seed, log)
+    ok, detail = run_one(genomes, work, mode, seed, log,
+                         precluster=precluster, extra_env=extra_env)
     if not ok:
         return False, "\n".join(log + [detail])
     ckpt = os.path.join(work, "ckpt")
@@ -517,8 +539,17 @@ def run_index_harness(iterations: int, seed: int, workdir: str,
 
 
 def run_harness(iterations: int, seed: int, workdir: str,
-                verbose: bool = True) -> int:
-    """Full chaos loop; returns the number of FAILED iterations."""
+                verbose: bool = True, overlap: bool = False) -> int:
+    """Full chaos loop; returns the number of FAILED iterations.
+
+    With ``overlap=True`` every child run (reference, interrupted, and
+    resume) uses the finch preclusterer with the overlapped dataflow
+    forced on, so kills land inside the single fused pipeline — mid
+    ingest, mid speculative fragment batch, or at the quiesce point —
+    and the byte-identity gate proves the overlapped engine is exactly
+    as preemption-safe as the stage-serial one."""
+    precluster = "finch" if overlap else "skani"
+    extra_env = OVERLAP_ENV if overlap else None
     gdir = os.path.join(workdir, "genomes")
     os.makedirs(gdir, exist_ok=True)
     genomes = make_workload(gdir, seed)
@@ -529,7 +560,8 @@ def run_harness(iterations: int, seed: int, workdir: str,
     ref_tsv = os.path.join(ref_work, "clusters.tsv")
     proc = launch(cluster_argv(
         genomes, ref_tsv, os.path.join(ref_work, "ckpt"),
-        os.path.join(ref_work, "report.json"), resume=False))
+        os.path.join(ref_work, "report.json"), resume=False,
+        precluster=precluster), extra_env)
     stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
     if proc.returncode != 0:
         print("FATAL: reference run failed:\n"
@@ -548,7 +580,9 @@ def run_harness(iterations: int, seed: int, workdir: str,
     failures = 0
     for i, mode in enumerate(schedule):
         ok, detail = run_iteration(genomes, reference, workdir, mode,
-                                   seed * 1000 + i)
+                                   seed * 1000 + i,
+                                   precluster=precluster,
+                                   extra_env=extra_env)
         status = "PASS" if ok else "FAIL"
         if verbose or not ok:
             print(f"[{i + 1:2d}/{iterations}] {mode:<10s} {status}")
@@ -572,18 +606,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch dir for inspection")
     ap.add_argument("--workload", default="cluster",
-                    choices=("cluster", "index-insert"),
+                    choices=("cluster", "cluster-overlap",
+                             "index-insert"),
                     help="what to kill: a checkpointed cluster run "
-                         "(default) or an incremental `index insert` "
-                         "against a prebuilt index")
+                         "(default), the same run with the overlapped "
+                         "dataflow forced on (cluster-overlap), or an "
+                         "incremental `index insert` against a "
+                         "prebuilt index")
     args = ap.parse_args(argv)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="galah_chaos_")
     print(f"chaos scratch: {workdir}")
     try:
-        harness = (run_index_harness if args.workload == "index-insert"
-                   else run_harness)
-        failures = harness(args.iterations, args.seed, workdir)
+        if args.workload == "index-insert":
+            failures = run_index_harness(args.iterations, args.seed,
+                                         workdir)
+        else:
+            failures = run_harness(
+                args.iterations, args.seed, workdir,
+                overlap=args.workload == "cluster-overlap")
     finally:
         if not args.keep and not args.workdir:
             shutil.rmtree(workdir, ignore_errors=True)

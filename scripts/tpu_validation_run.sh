@@ -141,6 +141,12 @@ run_stage san_smoke 600 env JAX_PLATFORMS=cpu \
 # depend on checkpoint/resume behaving.
 run_stage chaos_smoke 900 env JAX_PLATFORMS=cpu \
   python -u scripts/chaos_run.py --iterations 10 --seed 1
+# Same kill/resume gate with the overlapped dataflow forced on (finch
+# precluster + GALAH_TPU_OVERLAP=1): kills land inside the fused
+# pipeline and the resumed clusters must still be byte-identical.
+run_stage chaos_overlap 900 env JAX_PLATFORMS=cpu \
+  python -u scripts/chaos_run.py --iterations 6 --seed 2 \
+  --workload cluster-overlap
 run_stage test_tpu_hw 2400 env GALAH_RUN_SLOW=1 \
   python -u -m pytest tests/test_tpu_hw.py -q
 run_stage amortized 1800 python -u scripts/bench_amortized.py
@@ -156,6 +162,13 @@ run_stage bench "$BENCH_TIMEOUT" env \
 # (also runs inside bench.py; the dedicated stage survives a bench.py
 # wedge and lands in its own artifact).
 run_stage engine_rounds 900 python -u scripts/bench_engine_rounds.py \
+  --budget 840
+# Stage-serial vs fully overlapped end-to-end dataflow on the same
+# 1000-genome rung: parity gate + genomes/s for both schedules, the
+# overlap counters, and the per-stage pipeline-occupancy gauges (also
+# runs inside bench.py; the dedicated stage survives a bench.py wedge
+# and lands in its own artifact).
+run_stage e2e_overlap 900 python -u scripts/bench_overlap.py \
   --budget 840
 # Perf gate right after the bench stages: the newest ledger entries
 # (appended by the bench/engine finalizers above) against their

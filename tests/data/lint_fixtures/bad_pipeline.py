@@ -44,3 +44,7 @@ def build_handoffs():
     sq = queue.SimpleQueue()            # GL1003 (cannot be bounded)
     pool = ThreadPoolExecutor()         # GL1003 (no max_workers)
     return q, sq, pool
+
+
+def drain_again(paths):
+    return tuple(iter_rows(paths))      # GL1001 (tuple materialization)

@@ -650,8 +650,9 @@ def test_bad_pipeline_fires_every_rule():
     by_code = {}
     for f in found:
         by_code.setdefault(f.code, []).append(f.line)
-    # direct list(iter_rows(...)) + sorted() over a bound stream
-    assert sorted(by_code["GL1001"]) == [36, 38]
+    # direct list(iter_rows(...)) + sorted() over a bound stream +
+    # tuple() materialization
+    assert sorted(by_code["GL1001"]) == [36, 38, 50]
     # block_until_ready inside the declared streaming stage
     assert by_code["GL1002"] == [27]
     # Queue() no maxsize, SimpleQueue(), ThreadPoolExecutor() bare

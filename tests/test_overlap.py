@@ -1,0 +1,503 @@
+"""Overlapped end-to-end dataflow (cluster/engine._cluster_overlapped).
+
+The contract under test: with GALAH_TPU_OVERLAP engaged, sketch ->
+pair-screen -> speculative fragment-ANI -> eager greedy rounds run as
+ONE pipeline, and the clustering is BIT-IDENTICAL to the stage-serial
+engine on every workload — the frontier rule only changes WHEN work
+runs, never what is decided. These tests pin that parity on the
+planted-family rung shape and the dense single-family worst case,
+the frontier/window soundness cases, forced-vs-auto engagement
+semantics, the quiesce-at-checkpoint protocol, and the bounded
+speculative buffer under injected slow ingest (docs/dataflow.md).
+"""
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pytest
+
+from galah_tpu.backends.base import ClusterBackend, PreclusterBackend
+from galah_tpu.cluster import cluster
+from galah_tpu.cluster.cache import PairDistanceCache
+from galah_tpu.cluster.checkpoint import ClusterCheckpoint, run_fingerprint
+from galah_tpu.obs import metrics as obs_metrics
+from galah_tpu.resilience import interrupt
+from galah_tpu.utils import timing
+
+
+class TablePre(PreclusterBackend):
+    def __init__(self, pairs):
+        self.pairs = pairs
+
+    def method_name(self):
+        return "stub-pre"
+
+    def distances(self, genome_paths):
+        cache = PairDistanceCache()
+        for (i, j), ani in self.pairs.items():
+            cache.insert((i, j), ani)
+        return cache
+
+
+class StreamTablePre(TablePre):
+    """TablePre plus the streamed pair pass the overlapped engine
+    consumes: hit pairs arrive in blocks of `block` rows, each yield
+    completing the pair neighborhood of the prefix [0, r1) — the same
+    contract as MinHashPreclusterer.distances_streamed (a pair (i, j)
+    becomes known when its LATER row is screened)."""
+
+    def __init__(self, pairs, n, block=7, fail_at_row=None):
+        super().__init__(pairs)
+        self.n = n
+        self.block = block
+        self.fail_at_row = fail_at_row
+
+    def distances_streamed(self, genome_paths):
+        assert len(genome_paths) == self.n
+        by_row = {}
+        for (i, j), ani in self.pairs.items():
+            by_row.setdefault(max(i, j), {})[(i, j)] = ani
+
+        def gen():
+            r1 = 0
+            while r1 < self.n:
+                r0, r1 = r1, min(r1 + self.block, self.n)
+                if (self.fail_at_row is not None
+                        and r1 > self.fail_at_row):
+                    raise RuntimeError("injected stream failure")
+                inc = {}
+                for r in range(r0, r1):
+                    inc.update(by_row.get(r, {}))
+                yield r1, inc
+
+        return gen()
+
+
+class TableCl(ClusterBackend):
+    """Exact ANI from a lookup table; absent pairs are gated (None)."""
+
+    def __init__(self, table, threshold):
+        self.table = {frozenset(k): v for k, v in table.items()}
+        self.threshold = threshold
+        self.calls: List[list] = []
+        self.pairs_computed: List[tuple] = []
+
+    def method_name(self):
+        return "stub-exact"
+
+    @property
+    def ani_threshold(self):
+        return self.threshold
+
+    def calculate_ani_batch(
+            self, pairs: Sequence[tuple]) -> List[Optional[float]]:
+        self.calls.append(list(pairs))
+        self.pairs_computed.extend(pairs)
+        return [self.table.get(frozenset(p)) for p in pairs]
+
+
+class ConstCl(ClusterBackend):
+    """Every pair at a fixed ANI — for real-backend workloads where the
+    pair table is not known up front."""
+
+    def __init__(self, threshold=0.95, ani=0.97):
+        self.threshold = threshold
+        self.ani = ani
+
+    def method_name(self):
+        return "stub-exact"
+
+    @property
+    def ani_threshold(self):
+        return self.threshold
+
+    def calculate_ani_batch(self, pairs):
+        return [self.ani] * len(pairs)
+
+
+def g(n):
+    return [f"g{i}.fna" for i in range(n)]
+
+
+def _family_workload(n_families, fam_size, seed, none_rate=0.05,
+                     thr=0.95):
+    """Planted families with randomized exact ANIs straddling the
+    threshold (and a few gated-None pairs) — the bench rung shape,
+    same generator as tests/test_greedy_rounds.py."""
+    rng = np.random.default_rng(seed)
+    pre, table = {}, {}
+    for f in range(n_families):
+        base = f * fam_size
+        for a in range(fam_size):
+            for b in range(a + 1, fam_size):
+                i, j = base + a, base + b
+                pre[(i, j)] = 0.96
+                if rng.random() < none_rate:
+                    table[(f"g{i}.fna", f"g{j}.fna")] = None
+                else:
+                    table[(f"g{i}.fna", f"g{j}.fna")] = round(
+                        float(rng.uniform(thr - 0.05, thr + 0.04)), 6)
+    return pre, table
+
+
+def _serial(monkeypatch, n, pre, table, thr=0.95, **kw):
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "0")
+    return cluster(g(n), TablePre(pre), TableCl(table, thr), **kw)
+
+
+def _overlapped(monkeypatch, n, pre, table, thr=0.95, block=7,
+                pre_backend=None, cl=None, **kw):
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "1")
+    backend = pre_backend or StreamTablePre(pre, n, block=block)
+    return cluster(g(n), backend, cl or TableCl(table, thr), **kw)
+
+
+def test_overlap_planted_families_1000_parity(monkeypatch):
+    """Golden-cluster equality on the 1000-genome rung shape, and the
+    engagement counter proves the overlapped engine actually ran."""
+    pre, table = _family_workload(250, 4, seed=11)
+    serial = _serial(monkeypatch, 1000, pre, table)
+    before = timing.GLOBAL.counters()
+    over = _overlapped(monkeypatch, 1000, pre, table, block=64)
+    after = timing.GLOBAL.counters()
+    assert over == serial
+    assert after.get("overlap-engaged", 0) == before.get(
+        "overlap-engaged", 0) + 1
+    assert after.get("overlap-eager-rounds", 0) > before.get(
+        "overlap-eager-rounds", 0)
+
+
+def test_overlap_dense_single_family_parity(monkeypatch):
+    """The mega-family worst case: ONE precluster, every pair a hit,
+    ANIs straddling the threshold so rep chains and argmax ties both
+    occur — the union-find grouping must keep decisions identical."""
+    rng = np.random.default_rng(3)
+    n = 96
+    pre, table = {}, {}
+    for i in range(n):
+        for j in range(i + 1, n):
+            pre[(i, j)] = 0.96
+            table[(f"g{i}.fna", f"g{j}.fna")] = round(
+                float(rng.uniform(0.90, 0.99)), 6)
+    serial = _serial(monkeypatch, n, pre, table)
+    over = _overlapped(monkeypatch, n, pre, table, block=5)
+    assert over == serial
+
+
+def test_overlap_block_and_width_invariance(monkeypatch):
+    """Arrival granularity and round width change batching only —
+    every (block, rep_rounds) combination yields the stage-serial
+    clustering."""
+    pre, table = _family_workload(6, 4, seed=7)
+    serial = _serial(monkeypatch, 24, pre, table)
+    for block in (1, 3, 5, 24):
+        for width in (1, 3, 7, 64):
+            over = _overlapped(monkeypatch, 24, pre, table, block=block,
+                               rep_rounds=width)
+            assert over == serial, f"block={block} rep_rounds={width}"
+
+
+def test_overlap_rounds_run_before_stream_ends(monkeypatch):
+    """Genuine overlap: greedy/fragment dispatches happen while the
+    pair stream is still producing (backend calls strictly before the
+    final block is delivered), and one eager round runs per window."""
+    pre, table = _family_workload(6, 4, seed=5, none_rate=0.0)
+    n = 24
+    serial = _serial(monkeypatch, n, pre, table)
+
+    pre_backend = StreamTablePre(pre, n, block=4)
+    cl = TableCl(table, 0.95)
+    trace = []
+    inner = pre_backend.distances_streamed
+
+    def traced(paths, _inner=inner):
+        stream = _inner(paths)
+
+        def gen():
+            for r1, inc in stream:
+                trace.append((r1, len(cl.calls)))
+                yield r1, inc
+
+        return gen()
+
+    pre_backend.distances_streamed = traced
+    before = timing.GLOBAL.counters()
+    over = _overlapped(monkeypatch, n, pre, table,
+                       pre_backend=pre_backend, cl=cl, rep_rounds=4)
+    after = timing.GLOBAL.counters()
+    assert over == serial
+    # dispatches before the last block arrived = overlapped execution
+    assert any(calls > 0 for r1, calls in trace if r1 < n)
+    assert after.get("overlap-eager-rounds", 0) - before.get(
+        "overlap-eager-rounds", 0) == n // 4  # one per window
+    assert after.get("overlap-spec-pairs", 0) > before.get(
+        "overlap-spec-pairs", 0)
+
+
+def test_overlap_late_genome_joins_early_precluster(monkeypatch):
+    """Frontier rule: a genome whose only hit edge arrives long after
+    its partner's window was eagerly resolved still joins that early
+    rep's cluster."""
+    n = 24
+    pre = {(0, 23): 0.96}  # the ONLY hit edge; the rest are singletons
+    table = {("g0.fna", "g23.fna"): 0.97}
+    serial = _serial(monkeypatch, n, pre, table)
+    over = _overlapped(monkeypatch, n, pre, table, block=2,
+                       rep_rounds=2)
+    assert over == serial
+    assert [0, 23] in over
+
+
+def test_overlap_late_rep_wins_membership_argmax(monkeypatch):
+    """Membership must wait for stream completion: non-rep 1 is
+    claimed by early rep 0 but a LATER rep 2 has the higher ANI, so
+    the final argmax assigns 1 to 2 — identically in both engines."""
+    pre = {(0, 1): 0.96, (1, 2): 0.96}
+    table = {("g0.fna", "g1.fna"): 0.96, ("g1.fna", "g2.fna"): 0.98}
+    serial = _serial(monkeypatch, 3, pre, table)
+    over = _overlapped(monkeypatch, 3, pre, table, block=1,
+                       rep_rounds=1)
+    assert over == serial == [[0], [2, 1]]
+
+
+def test_overlap_forced_requires_stream_and_device(monkeypatch):
+    """GALAH_TPU_OVERLAP=1 propagates ineligibility: a preclusterer
+    without a streamed pair pass, or a pinned host greedy strategy,
+    is a hard error instead of a silent serial run."""
+    pre, table = _family_workload(2, 3, seed=1)
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "1")
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+    with pytest.raises(RuntimeError, match="did not engage"):
+        cluster(g(6), TablePre(pre), TableCl(table, 0.95))
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "host")
+    with pytest.raises(RuntimeError, match="device greedy"):
+        cluster(g(6), StreamTablePre(pre, 6), TableCl(table, 0.95))
+
+
+def test_overlap_auto_demotes_on_stream_failure(monkeypatch):
+    """AUTO mode: a mid-stream failure demotes to the stage-serial
+    engine from scratch and still produces the correct clustering;
+    forced mode propagates the same failure."""
+    pre, table = _family_workload(6, 4, seed=13)
+    n = 24
+    serial = _serial(monkeypatch, n, pre, table)
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "auto")
+    before = timing.GLOBAL.counters()
+    out = cluster(g(n), StreamTablePre(pre, n, block=4, fail_at_row=10),
+                  TableCl(table, 0.95))
+    after = timing.GLOBAL.counters()
+    assert out == serial
+    assert after.get("overlap-demoted", 0) == before.get(
+        "overlap-demoted", 0) + 1
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "1")
+    with pytest.raises(RuntimeError, match="injected stream failure"):
+        cluster(g(n), StreamTablePre(pre, n, block=4, fail_at_row=10),
+                TableCl(table, 0.95))
+
+
+def test_overlap_checkpoint_completes_and_clears_rounds(
+        monkeypatch, tmp_path):
+    """A checkpointed overlapped run quiesces before every durable
+    write, finishes with the stage-serial clustering, clears
+    greedy_rounds.jsonl, and a resume serves everything from the
+    completed-precluster log with ZERO backend calls."""
+    pre, table = _family_workload(10, 4, seed=9, none_rate=0.0)
+    n = 40
+    serial = _serial(monkeypatch, n, pre, table)
+    fp = run_fingerprint(g(n), "stub-pre", "stub-exact", 0.95, 0.9)
+    ck = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    over = _overlapped(monkeypatch, n, pre, table, block=6,
+                       checkpoint=ck)
+    assert over == serial
+    assert not (tmp_path / "ck" / "greedy_rounds.jsonl").exists()
+
+    ck2 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    cl2 = TableCl(table, 0.95)
+    out = _overlapped(monkeypatch, n, pre, table, block=6,
+                      pre_backend=StreamTablePre(pre, n, block=6),
+                      cl=cl2, checkpoint=ck2)
+    assert out == serial
+    assert cl2.calls == []
+
+
+def test_overlap_preempted_run_resumes_stage_serial(
+        monkeypatch, tmp_path):
+    """Kill at the greedy-round-saved boundary: the overlapped run
+    saved its streaming-phase ANIs as ONE digest-bound round record,
+    the resume disengages overlap (checkpointed distances), replays
+    the record with zero recomputation, and lands on the identical
+    clustering — no pair is paid for twice across the two runs."""
+    pre, table = _family_workload(10, 4, seed=9, none_rate=0.0)
+    n = 40
+    serial = _serial(monkeypatch, n, pre, table)
+
+    fp = run_fingerprint(g(n), "stub-pre", "stub-exact", 0.95, 0.9)
+    ck1 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    saved = ck1.save_greedy_round
+
+    def save_then_stop(digest, pairs):
+        saved(digest, pairs)
+        interrupt.request_stop()
+
+    monkeypatch.setattr(ck1, "save_greedy_round", save_then_stop)
+    cl1 = TableCl(table, 0.95)
+    interrupt.reset()
+    try:
+        with pytest.raises(interrupt.PreemptionRequested):
+            _overlapped(monkeypatch, n, pre, table, block=6,
+                        pre_backend=StreamTablePre(pre, n, block=6),
+                        cl=cl1, checkpoint=ck1)
+    finally:
+        interrupt.reset()
+    assert (tmp_path / "ck" / "greedy_rounds.jsonl").exists()
+
+    # resume is stage-serial BY DESIGN, even with overlap still forced
+    # (checkpointed distances make the run ineligible, not failed) —
+    # and the plain TablePre proves no stream is needed to resume
+    before = timing.GLOBAL.counters()
+    ck2 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    cl2 = TableCl(table, 0.95)
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "1")
+    out = cluster(g(n), TablePre(pre), cl2, checkpoint=ck2)
+    after = timing.GLOBAL.counters()
+    assert out == serial
+    assert after.get("greedy-replayed-pairs", 0) > before.get(
+        "greedy-replayed-pairs", 0)
+    paid1 = set(map(frozenset, cl1.pairs_computed))
+    paid2 = set(map(frozenset, cl2.pairs_computed))
+    assert not (paid1 & paid2)
+    assert not (tmp_path / "ck" / "greedy_rounds.jsonl").exists()
+
+
+def test_overlap_depth_bounds_spec_buffer(monkeypatch):
+    """GALAH_TPU_OVERLAP_DEPTH is a hard bound on the speculative
+    fragment-ANI buffer: the pending high-water mark never exceeds it
+    and the offered pairs arrive split over multiple batches."""
+    pre, table = _family_workload(8, 6, seed=17, none_rate=0.0)
+    n = 48
+    serial = _serial(monkeypatch, n, pre, table)
+    monkeypatch.setenv("GALAH_TPU_OVERLAP_DEPTH", "4")
+    obs_metrics.reset()
+    before = timing.GLOBAL.counters()
+    over = _overlapped(monkeypatch, n, pre, table, block=3,
+                       rep_rounds=6)
+    after = timing.GLOBAL.counters()
+    assert over == serial
+    snap = obs_metrics.snapshot()
+    peak = snap["overlap.spec_pending_peak"]["value"]
+    assert peak is not None and 0 < peak <= 4
+    assert after.get("overlap-spec-batches", 0) - before.get(
+        "overlap-spec-batches", 0) >= 2
+
+
+def test_overlap_occupancy_gauges(monkeypatch):
+    """The overlapped run reports per-stage occupancy (greedy and
+    fragment from the engine; the unlabelled whole-pipeline gauge is
+    their mean), every value clamped to [0, 1]."""
+    pre, table = _family_workload(8, 4, seed=19)
+    n = 32
+    obs_metrics.reset()
+    _overlapped(monkeypatch, n, pre, table, block=4, rep_rounds=4)
+    snap = obs_metrics.snapshot()
+    for name in ("workload.pipeline_occupancy[greedy]",
+                 "workload.pipeline_occupancy[fragment]",
+                 "workload.pipeline_occupancy"):
+        assert name in snap, name
+        v = snap[name]["value"]
+        assert v is not None and 0.0 <= v <= 1.0, name
+    assert snap["overlap.eager_rounds"]["value"] == n // 4
+    assert snap["overlap.spec_pairs"]["value"] > 0
+
+
+def test_overlap_mode_and_depth_parsing(monkeypatch):
+    from galah_tpu.cluster import engine
+
+    monkeypatch.delenv("GALAH_TPU_OVERLAP", raising=False)
+    assert engine._overlap_mode() == "auto"
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "1")
+    assert engine._overlap_mode() == "1"
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "bogus")
+    assert engine._overlap_mode() == "auto"
+    monkeypatch.delenv("GALAH_TPU_OVERLAP_DEPTH", raising=False)
+    assert engine._overlap_depth() == 512
+    monkeypatch.setenv("GALAH_TPU_OVERLAP_DEPTH", "7")
+    assert engine._overlap_depth() == 7
+    monkeypatch.setenv("GALAH_TPU_OVERLAP_DEPTH", "0")
+    assert engine._overlap_depth() == 1
+    monkeypatch.setenv("GALAH_TPU_OVERLAP_DEPTH", "oops")
+    assert engine._overlap_depth() == 512
+
+
+def test_overlap_backpressure_under_slow_ingest(monkeypatch, tmp_path):
+    """The whole pipeline end-to-end on real FASTAs with injected
+    slow ingest (GALAH_FI slow-io at the io.ingest site) and a tiny
+    in-flight window: the run completes, matches the stage-serial
+    clustering byte-for-byte, keeps the speculative buffer within
+    GALAH_TPU_OVERLAP_DEPTH, and reports occupancy for every stage."""
+    from galah_tpu.backends.minhash_backend import MinHashPreclusterer
+    from galah_tpu.io.diskcache import CacheDir
+    from galah_tpu.resilience import faults
+
+    rng = np.random.default_rng(21)
+    base = rng.choice(list("ACGT"), size=5000)
+    paths = []
+    for i in range(6):
+        seq = base.copy()
+        if i >= 3:  # second family
+            sites = rng.random(seq.shape[0]) < 0.03
+            seq[sites] = rng.choice(list("ACGT"),
+                                    size=int(sites.sum()))
+        p = tmp_path / f"m{i}.fna"
+        p.write_text(">c\n" + "".join(seq) + "\n")
+        paths.append(str(p))
+
+    # the single-device-CPU AUTO strategy is "c", which keeps the
+    # historical staged shape — pin the device (XLA) strategy so the
+    # streamed pair pass engages on this host
+    monkeypatch.setenv("GALAH_TPU_SKETCH_STRATEGY", "xla")
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "0")
+    serial = cluster(
+        paths,
+        MinHashPreclusterer(0.95, sketch_size=64,
+                            cache=CacheDir(str(tmp_path / "c_ser"))),
+        ConstCl())
+
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "1")
+    monkeypatch.setenv("GALAH_TPU_OVERLAP_DEPTH", "2")
+    monkeypatch.setenv(
+        "GALAH_FI",
+        "site=io.ingest;kind=slow-io;prob=1;seed=1;hang=0.02")
+    faults.reset()
+    obs_metrics.reset()
+    try:
+        over = cluster(
+            paths,
+            MinHashPreclusterer(0.95, sketch_size=64,
+                                cache=CacheDir(str(tmp_path / "c_ov"))),
+            ConstCl())
+    finally:
+        monkeypatch.delenv("GALAH_FI")
+        faults.reset()
+    assert over == serial
+    snap = obs_metrics.snapshot()
+    peak = snap["overlap.spec_pending_peak"]["value"]
+    assert peak is not None and peak <= 2
+    for stage in ("ingest", "sketch", "pairs", "greedy", "fragment"):
+        name = f"workload.pipeline_occupancy[{stage}]"
+        assert name in snap, name
+        v = snap[name]["value"]
+        assert v is not None and 0.0 <= v <= 1.0, name
+    assert snap["workload.pipeline_occupancy"]["value"] is not None
+
+
+def test_overlap_flags_registered(monkeypatch):
+    from galah_tpu.config import env_value
+
+    monkeypatch.delenv("GALAH_TPU_OVERLAP", raising=False)
+    monkeypatch.delenv("GALAH_TPU_OVERLAP_DEPTH", raising=False)
+    assert env_value("GALAH_TPU_OVERLAP") == "auto"
+    assert env_value("GALAH_TPU_OVERLAP_DEPTH") == "512"
