@@ -627,6 +627,12 @@ def _run_e2e_overlap_stage(stages, errors):
             stages[f"e2e_overlap_occupancy_{stage_name}"] = v
         for k, v in (data.get("counters") or {}).items():
             stages[f"e2e_overlap_{k}"] = v
+        # critical-path blame shares -> bench.flow_* gauges, so a
+        # migrated bottleneck shows in the ledger like any perf drift
+        flow = data.get("flow") or {}
+        for stage_name, v in (flow.get("shares") or {}).items():
+            if isinstance(v, (int, float)):
+                stages[f"flow_{stage_name}_share"] = v
     except Exception as e:  # noqa: BLE001
         errors.append(f"e2e_overlap: {type(e).__name__}: {e}")
 

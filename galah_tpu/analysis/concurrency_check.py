@@ -63,6 +63,8 @@ THREADED_MODULES = (
     "galah_tpu/obs/trace.py",
     "galah_tpu/obs/events.py",
     "galah_tpu/obs/profile.py",
+    "galah_tpu/obs/flow.py",
+    "galah_tpu/obs/heartbeat.py",
     "galah_tpu/io/prefetch.py",
     "galah_tpu/resilience/dispatch.py",
     "galah_tpu/resilience/policy.py",

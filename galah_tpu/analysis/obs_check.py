@@ -38,6 +38,17 @@ Checks
          ``cost_analysis()`` forces a second trace/lowering of a
          function the profiler already compiled. obs/ is exempt by
          scope, so profile.py itself is the one sanctioned caller.
+  GL704  flow discipline for pipeline-stage modules: a module that
+         declares a ``PIPELINE_STAGE`` contract must emit its queue
+         telemetry through ``obs/flow.py`` — (a) the module never
+         imports/calls ``galah_tpu.obs.flow`` at all (anchored at the
+         ``PIPELINE_STAGE`` line), or (b) it hand-rolls queue-wait
+         timing: an assignment to a ``*wait*`` name computed from a
+         raw clock read (``time.monotonic`` included here — it is the
+         sanctioned deadline clock, but a wait accumulated from it
+         bypasses the flow recorder's blocked-on attribution and the
+         report's critical path). Wrap the dequeue in
+         ``obs.flow.blocked(stage, reason)`` and read ``.seconds``.
 
 Suppression: the usual inline comment on the flagged line or the line
 above, with a justification —
@@ -72,6 +83,12 @@ TIMING_CALLS = frozenset({
 # hit is a real bypass of the profiler.
 DEVICE_COST_CALLS = frozenset({"memory_stats", "cost_analysis"})
 
+# The clocks GL704 treats as hand-rolled queue timing when they feed a
+# ``*wait*`` accumulator in a PIPELINE_STAGE module. time.monotonic is
+# allowed everywhere else (deadline/budget accounting) but a wait
+# derived from it bypasses obs/flow.py's blocked-on attribution.
+_QUEUE_CLOCKS = TIMING_CALLS | frozenset({"time.monotonic"})
+
 _EXEMPT_PREFIXES = ("galah_tpu/utils/", "galah_tpu/obs/",
                     "galah_tpu/analysis/")
 
@@ -93,7 +110,8 @@ def in_scope(path: str) -> bool:
     return not p.startswith(_EXEMPT_PREFIXES)
 
 
-def _time_aliases(tree: ast.Module) -> Dict[str, str]:
+def _time_aliases(tree: ast.Module,
+                  banned: frozenset = TIMING_CALLS) -> Dict[str, str]:
     """name-as-written -> canonical dotted name for the time module
     and its banned members, resolving import aliases."""
     alias: Dict[str, str] = {}
@@ -105,9 +123,25 @@ def _time_aliases(tree: ast.Module) -> Dict[str, str]:
         elif isinstance(node, ast.ImportFrom) and node.module == "time":
             for a in node.names:
                 full = f"time.{a.name}"
-                if full in TIMING_CALLS:
+                if full in banned:
                     alias[a.asname or a.name] = full
     return alias
+
+
+def _resolve_clock(call: ast.Call, aliases: Dict[str, str],
+                   banned: frozenset) -> "str | None":
+    """Canonical dotted name of a banned clock call, alias-resolved;
+    None when the call is not one."""
+    name = dotted_name(call.func)
+    if name in banned:
+        return name
+    if "." in name:
+        head, _, tail = name.partition(".")
+        if aliases.get(head) == "time" and f"time.{tail}" in banned:
+            return f"time.{tail}"
+        return None
+    full = aliases.get(name)
+    return full if full in banned else None
 
 
 def _is_log_call(node: ast.Call) -> bool:
@@ -156,17 +190,7 @@ def check_obs_file(src: SourceFile) -> List[Finding]:
     for node in ast.walk(src.tree):
         if not isinstance(node, ast.Call):
             continue
-        name = dotted_name(node.func)
-        resolved = None
-        if name in TIMING_CALLS:
-            resolved = name
-        elif "." in name:
-            head, _, tail = name.partition(".")
-            if aliases.get(head) == "time" and f"time.{tail}" in \
-                    TIMING_CALLS:
-                resolved = f"time.{tail}"
-        elif aliases.get(name) in TIMING_CALLS:
-            resolved = aliases[name]
+        resolved = _resolve_clock(node, aliases, TIMING_CALLS)
         if resolved is not None:
             findings.append(Finding(
                 "GL701", Severity.WARNING, src.path, node.lineno,
@@ -196,4 +220,89 @@ def check_obs_file(src: SourceFile) -> List[Finding]:
                 "duration that lives only in the log; record it in "
                 "the obs.metrics registry (and log it too if useful) "
                 "so `galah-tpu report --diff` can see it"))
+    findings.extend(_check_flow_discipline(src))
+    return findings
+
+
+def _flow_imports(tree: ast.Module):
+    """(module-alias names, directly imported function names) bound to
+    galah_tpu.obs.flow anywhere in the file — module-level or the
+    lazy function-level imports the pipeline modules use."""
+    mod_names = set()
+    fn_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "galah_tpu.obs.flow" and a.asname:
+                    mod_names.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "galah_tpu.obs":
+                for a in node.names:
+                    if a.name == "flow":
+                        mod_names.add(a.asname or "flow")
+            elif node.module == "galah_tpu.obs.flow":
+                for a in node.names:
+                    fn_names.add(a.asname or a.name)
+    return mod_names, fn_names
+
+
+def _check_flow_discipline(src: SourceFile) -> List[Finding]:
+    """GL704 over one in-scope file: only fires on modules declaring a
+    module-level ``PIPELINE_STAGE`` contract."""
+    stage_line = None
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "PIPELINE_STAGE"
+                for t in node.targets):
+            stage_line = node.lineno
+            break
+    if stage_line is None:
+        return []
+    findings: List[Finding] = []
+    mod_names, fn_names = _flow_imports(src.tree)
+    uses_flow = False
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if dotted_name(fn.value).partition(".")[0] in mod_names:
+                uses_flow = True
+                break
+        elif isinstance(fn, ast.Name) and fn.id in fn_names:
+            uses_flow = True
+            break
+    if not uses_flow:
+        findings.append(Finding(
+            "GL704", Severity.WARNING, src.path, stage_line,
+            "module declares PIPELINE_STAGE but never emits flow "
+            "spans — bracket its dequeues with obs.flow.blocked() and "
+            "its work with obs.flow.record_service()/span() so the "
+            "run report's critical path can attribute this stage"))
+    aliases = _time_aliases(src.tree, banned=_QUEUE_CLOCKS)
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.Assign, ast.AugAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        waitish = any(
+            "wait" in (t.id if isinstance(t, ast.Name) else
+                       t.attr if isinstance(t, ast.Attribute) else
+                       "").lower()
+            for t in targets)
+        if not waitish:
+            continue
+        clock = next(
+            (_resolve_clock(c, aliases, _QUEUE_CLOCKS)
+             for c in ast.walk(node.value)
+             if isinstance(c, ast.Call)
+             and _resolve_clock(c, aliases, _QUEUE_CLOCKS)), None)
+        if clock is not None:
+            findings.append(Finding(
+                "GL704", Severity.WARNING, src.path, node.lineno,
+                f"hand-rolled queue-wait timing ({clock}() feeding a "
+                "wait accumulator) in a PIPELINE_STAGE module — wrap "
+                "the dequeue in obs.flow.blocked(stage, reason) and "
+                "accumulate its .seconds so the wait carries blocked-"
+                "on attribution in the report's critical path"))
     return findings

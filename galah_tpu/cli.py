@@ -285,6 +285,46 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Report regressions but exit 0 — the CI "
                           "mode while a key is still accumulating "
                           "trustworthy history")
+    fl = sub.add_parser(
+        "flow",
+        help="Critical-path analysis of a run's flow telemetry",
+        description="Item-level flow tracing over the overlapped "
+                    "pipeline (GALAH_OBS_FLOW, on by default) records "
+                    "per-stage service/wait time and inter-stage queue "
+                    "latencies into the run report's `flow` section; "
+                    "`analyze` recomputes the critical path from a "
+                    "report and prints per-stage blame shares that sum "
+                    "to the end-to-end wall "
+                    "(docs/observability.md)")
+    _add_verbosity(fl)
+    flsub = fl.add_subparsers(dest="flow_action")
+    fla = flsub.add_parser(
+        "analyze",
+        help="Print the critical path of one run report's flow "
+             "telemetry")
+    fla.add_argument("report", metavar="REPORT",
+                     help="run_report.json carrying a `flow` section")
+    fla.add_argument("--json", action="store_true",
+                     help="Emit the critical-path attribution as JSON "
+                          "instead of the rendered table")
+    tp = sub.add_parser(
+        "top",
+        help="Live pipeline view from a run's heartbeat.jsonl",
+        description="Render the newest record of the heartbeat file a "
+                    "run with GALAH_OBS_HEARTBEAT_S set writes beside "
+                    "its run report: per-stage occupancy bars, queue "
+                    "depths, and item throughput. Safe against a run "
+                    "killed mid-write — a torn tail line is skipped, "
+                    "never an error (docs/observability.md)")
+    _add_verbosity(tp)
+    tp.add_argument("directory", metavar="DIR",
+                    help="Run artifact directory (or a heartbeat.jsonl "
+                         "path directly)")
+    tp.add_argument("--follow", action="store_true",
+                    help="Keep refreshing until interrupted")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="Refresh period in seconds with --follow "
+                         "(default: 2.0)")
     ix = sub.add_parser(
         "index",
         help="Build and incrementally maintain a persistent versioned "
@@ -377,7 +417,8 @@ def build_parser() -> argparse.ArgumentParser:
              "checksums, cluster invariants (never mutates; jax-free)")
     parser._subcommand_parsers = {"cluster": c, "cluster-validate": v,
                                   "dist": dd, "lint": li, "report": rp,
-                                  "perf": pf, "index": ix}
+                                  "perf": pf, "flow": fl, "top": tp,
+                                  "index": ix}
     return parser
 
 
@@ -457,6 +498,11 @@ def run_cluster(args) -> int:
         obs.trace.start(trace_path)
     report_path = (getattr(args, "run_report", None)
                    or env_value("GALAH_OBS_REPORT"))
+    # Liveness heartbeat beside the report sink, plus crash/preemption
+    # flush hooks so an aborted run still leaves a final beat and a
+    # closed trace behind.
+    obs.install_crash_hooks()
+    obs.heartbeat.maybe_start(report_path)
     try:
         return _run_cluster_inner(args)
     finally:
@@ -811,6 +857,59 @@ def run_perf_cmd(args) -> int:
     return 1 if bad else 0
 
 
+def run_flow_cmd(args) -> int:
+    """`galah-tpu flow analyze`: critical-path attribution from a run
+    report's flow section. Pure file I/O (like `report`): never
+    touches jax."""
+    import json as _json
+
+    from galah_tpu.obs import flow as flow_mod
+    from galah_tpu.obs import report as report_mod
+
+    action = getattr(args, "flow_action", None)
+    if action is None:
+        logger.error("flow needs an action: analyze")
+        return 1
+    try:
+        rep = report_mod.load(args.report)
+    except Exception as e:  # noqa: BLE001 — bad JSON, missing file
+        logger.error("%s: cannot read run report (%s)", args.report, e)
+        return 1
+    snap = rep.get("flow") or {}
+    if not snap.get("stages"):
+        logger.error("%s: no flow telemetry (run a pipelined "
+                     "subcommand with GALAH_OBS_FLOW=1)", args.report)
+        return 1
+    wall = rep.get("run", {}).get("duration_s") or 0.0
+    cp = flow_mod.critical_path(snap, float(wall))
+    if getattr(args, "json", False):
+        print(_json.dumps(cp, indent=1, sort_keys=True))
+        return 0
+    for line in flow_mod.render_critical_path(cp):
+        print(line)
+    return 0
+
+
+def run_top_cmd(args) -> int:
+    """`galah-tpu top <dir>`: render the newest heartbeat of a live
+    (or finished) run. Pure file I/O: never touches jax, tolerates a
+    torn tail line from a run killed mid-append."""
+    from galah_tpu.obs import heartbeat as heartbeat_mod
+
+    follow = bool(getattr(args, "follow", False))
+    interval = max(float(getattr(args, "interval", 2.0) or 2.0), 0.1)
+    while True:
+        records, _torn = heartbeat_mod.load(args.directory)
+        sys.stdout.write(heartbeat_mod.render_latest(args.directory))
+        sys.stdout.flush()
+        if not follow:
+            return 0 if records else 1
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def _index_order_genomes(genomes, args):
     """Quality-order genomes for index build/insert; with no quality
     input, fall back to input order LOUDLY: a distinct warn_once key,
@@ -891,6 +990,9 @@ def run_index(args) -> int:
         obs.trace.start(trace_path)
     report_path = (getattr(args, "run_report", None)
                    or env_value("GALAH_OBS_REPORT"))
+    # Same heartbeat + crash-flush wiring as run_cluster.
+    obs.install_crash_hooks()
+    obs.heartbeat.maybe_start(report_path)
     try:
         return _run_index_inner(args, action, index_dir)
     finally:
@@ -1040,6 +1142,12 @@ def main(argv=None) -> int:
         # Same discipline: the ledger gate must run on CI hosts and
         # laptops with no accelerator, so it never imports jax.
         return run_perf_cmd(args)
+    if args.subcommand == "flow":
+        # Critical-path math over an already-written report — jax-free.
+        return run_flow_cmd(args)
+    if args.subcommand == "top":
+        # Tails heartbeat.jsonl — jax-free, usable while a run is live.
+        return run_top_cmd(args)
     platform = (getattr(args, "platform", None)
                 or os.environ.get("GALAH_TPU_PLATFORM"))
     if platform:

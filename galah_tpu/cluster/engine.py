@@ -633,6 +633,7 @@ def _cluster_overlapped(
              else greedy_select.DEFAULT_ROUND_WIDTH)
     if width < 1:
         raise ValueError(f"rep_rounds must be >= 1, got {width}")
+    from galah_tpu.obs import flow as obs_flow
     depth = _overlap_depth()
     n = len(genomes)
 
@@ -661,6 +662,7 @@ def _cluster_overlapped(
         stage-serial batch closure (_cluster_pending_rounds), plus
         fragment-stage busy accounting for the occupancy gauge."""
         t0 = time.monotonic()
+        fid = obs_flow.begin("fragment_batch")
         seen: Set[Tuple[int, int]] = set()
         uniq: List[Tuple[int, int]] = []
         for p in pairs:
@@ -690,7 +692,10 @@ def _cluster_overlapped(
             chunk.append(p)
             chunk_genomes.update(p)
         flush()
-        frag_busy[0] += time.monotonic() - t0
+        dt = time.monotonic() - t0
+        frag_busy[0] += dt
+        obs_flow.record_service("fragment", dt, items=len(uniq))
+        obs_flow.complete(fid)
 
     def value(i: int, j: int) -> Optional[float]:
         if skip_clusterer and pre_cache.contains((i, j)):
@@ -761,6 +766,7 @@ def _cluster_overlapped(
                 return
             window = list(range(frontier[0], end))
             t0 = time.monotonic()
+            fid = obs_flow.begin("greedy_round")
             fb0 = frag_busy[0]
             pc_of = {g: find(g) for g in window}
             reps_by_pc: Dict[int, List[int]] = {}
@@ -789,11 +795,32 @@ def _cluster_overlapped(
                 for t in adj[r]:
                     offer((r, t))
             frontier[0] = end
-            greedy_busy[0] += ((time.monotonic() - t0)
-                               - (frag_busy[0] - fb0))
+            dt = ((time.monotonic() - t0) - (frag_busy[0] - fb0))
+            greedy_busy[0] += dt
+            obs_flow.record_service("greedy", dt)
+            obs_flow.complete(fid)
+            # live gauge refresh so the heartbeat samples a moving
+            # occupancy time-series, not only the quiesce value
+            wall_now = max(time.monotonic() - t_start, 1e-9)
+            obs_metrics.pipeline_occupancy(
+                min(1.0, greedy_busy[0] / wall_now), stage="greedy")
+            if not skip_clusterer:
+                obs_metrics.pipeline_occupancy(
+                    min(1.0, frag_busy[0] / wall_now),
+                    stage="fragment")
 
     t_start = time.monotonic()
-    for r1, inc in stream:
+    stream_it = iter(stream)
+    while True:
+        # blocked on the upstream pair-screen stream (obs/flow records
+        # it as the greedy stage's upstream-empty wait — the signal
+        # `galah-tpu flow analyze` forwards to the producer's blame)
+        with obs_flow.blocked("greedy", "upstream-empty"):
+            try:
+                r1, inc = next(stream_it)
+            except StopIteration:
+                break
+        obs_flow.absorb("pairs", "greedy")
         for (a, b), v in inc.items():
             pre_cache.insert((a, b), v)
             adj[a].append(b)

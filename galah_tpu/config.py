@@ -224,6 +224,21 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "walls, HBM high-water, roofline utilization) into the "
               "run report's device_costs section; 0 disables the "
               "profiled-dispatch path entirely"),
+    Flag("GALAH_OBS_FLOW", kind="bool", default="1",
+         section="observability",
+         help="Flow-level pipeline tracing (galah_tpu/obs/flow.py): "
+              "flow ids on pipeline items, per-stage wait/service "
+              "histograms with blocked-on attribution, Chrome-trace "
+              "flow arrows, and the run report's flow section behind "
+              "`galah-tpu flow analyze`; 0 turns every record call "
+              "into a no-op"),
+    Flag("GALAH_OBS_HEARTBEAT_S", kind="float", default="0",
+         section="observability",
+         help="Period in seconds for the liveness heartbeat thread "
+              "(galah_tpu/obs/heartbeat.py): each beat durably "
+              "appends counters/gauges/queue-depth/occupancy to "
+              "heartbeat.jsonl beside the run report, rendered live "
+              "by `galah-tpu top <dir>`. 0 (the default) disables it"),
     Flag("GALAH_OBS_LEDGER", section="observability",
          help="Append one entry per finalized run to this cross-run "
               "perf ledger (JSONL, keyed by backend/topology/"

@@ -103,10 +103,18 @@ def iter_insert_sketches(
     ingest->sketch pipeline. Genomes already in the run's sketch store
     or the disk cache yield without touching FASTA — the property the
     "resketch only the new genomes" acceptance counter measures."""
+    from galah_tpu.obs import flow as obs_flow
     from galah_tpu.ops.sketch_stream import iter_path_sketches
 
-    for path, sk in iter_path_sketches(paths, sketch_store,
-                                       threads=threads):
+    it = iter_path_sketches(paths, sketch_store, threads=threads)
+    while True:
+        # blocked on the shared sketch pipeline: obs/flow attributes
+        # the index stage's starvation upstream (GL704 discipline)
+        with obs_flow.blocked("index-sketch", "upstream-empty"):
+            try:
+                path, sk = next(it)
+            except StopIteration:
+                break
         yield path, sk
 
 

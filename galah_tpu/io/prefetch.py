@@ -58,16 +58,19 @@ def _shared_pool(workers: int) -> ThreadPoolExecutor:
 
 
 def _adopting(fn: Callable[..., T]) -> Callable[..., T]:
-    """Wrap a pool-submitted callable so timing/trace emission from the
-    prefetch thread attributes to the stage that SUBMITTED the work —
-    without this, a worker's dispatch() lands on the thread-local stage
-    stack of a pool thread that never entered any stage."""
+    """Wrap a pool-submitted callable so timing/trace/flow emission
+    from the prefetch thread attributes to the stage that SUBMITTED
+    the work — without this, a worker's dispatch() (or flow span)
+    lands on the thread-local stack of a pool thread that never
+    entered any stage."""
+    from galah_tpu.obs import flow as obs_flow
     from galah_tpu.utils import timing
 
     token = timing.stage_token()
+    ftoken = obs_flow.token()
 
     def wrapped(*a):
-        with timing.adopt(token):
+        with timing.adopt(token), obs_flow.adopt(ftoken):
             return fn(*a)
 
     return wrapped
@@ -123,15 +126,21 @@ def iter_batches(
     accumulate-then-flush policy shared by the batched sketching
     backends. The underlying prefetch threads keep loading ahead while
     the caller processes each yielded buffer."""
+    from galah_tpu.obs import flow as obs_flow
+
     buf: list = []
     total = 0
     for path, item in items:
         buf.append((path, item))
         total += int(size_fn(item))
         if total >= budget or len(buf) >= max_items:
+            fid = obs_flow.begin("genome_batch")
+            obs_flow.emit("ingest", fid)
             yield buf
             buf, total = [], 0
     if buf:
+        fid = obs_flow.begin("genome_batch")
+        obs_flow.emit("ingest", fid)
         yield buf
 
 
@@ -156,7 +165,10 @@ def process_stream(
     GIL, so multicore hosts sketch that many genomes concurrently
     (results stream back in submission order)."""
     if batched:
+        from galah_tpu.obs import flow as obs_flow
+
         for buf in iter_batches(items, size_fn, budget):
+            obs_flow.absorb("ingest", "sketch")
             for (p, _), r in zip(buf, batch_fn(buf)):
                 yield p, r
     elif workers > 1:

@@ -14,6 +14,11 @@ lifecycle:
   * ``obs.report`` — assembles ``run_report.json`` at run end
     (``--run-report PATH`` / ``GALAH_OBS_REPORT``) and powers the
     ``galah-tpu report`` subcommand (render + ``--diff``).
+  * ``obs.flow`` — flow ids + per-stage wait/service spans with
+    blocked-on attribution for the overlapped pipeline, feeding the
+    report's ``flow`` section and ``galah-tpu flow analyze``.
+  * ``obs.heartbeat`` — the periodic ``heartbeat.jsonl`` liveness
+    snapshot (``GALAH_OBS_HEARTBEAT_S``) behind ``galah-tpu top``.
 
 ``reset_run()`` gives a run a clean slate; ``finalize()`` assembles,
 validates, and writes the report.
@@ -25,21 +30,27 @@ lazily, only at assembly time.
 
 from __future__ import annotations
 
+import atexit
 import logging
+import sys
 from typing import List, Optional
 
-from galah_tpu.obs import events, metrics, profile, trace  # noqa: F401
+from galah_tpu.obs import (events, flow, heartbeat, metrics,  # noqa: F401
+                           profile, trace)
 
 logger = logging.getLogger(__name__)
 
 
 def reset_run() -> None:
-    """Fresh metrics + events + profiler counters for a new run (trace
-    recorder unchanged: its lifetime is the CLI invocation, managed by
-    start/stop; the profiler's compiled caches survive too)."""
+    """Fresh metrics + events + profiler + flow counters for a new run
+    (trace recorder unchanged: its lifetime is the CLI invocation,
+    managed by start/stop; the profiler's compiled caches survive
+    too)."""
     metrics.reset()
     events.reset()
     profile.reset()
+    flow.reset()
+    heartbeat.reset()
     # Index-operation snapshot (stdlib-only package, safe to import
     # here): one run = at most one index op's summary in the report.
     from galah_tpu import index as index_pkg
@@ -60,6 +71,10 @@ def finalize(subcommand: str,
 
     out = None
     try:
+        # Stop the heartbeat FIRST (writes its final beat) so the
+        # report's occupancy time-series includes the whole run; the
+        # stop in the finally below is then an idempotent no-op.
+        heartbeat.stop()
         out = report_mod.assemble(subcommand, argv=argv,
                                   started_at=started_at, lint=lint)
         problems = report_mod.validate(out)
@@ -81,5 +96,53 @@ def finalize(subcommand: str,
     except Exception:
         logger.warning("run report assembly failed", exc_info=True)
     finally:
+        heartbeat.stop()
         trace.stop()
     return out
+
+
+# -- crash/preemption artifact flushing ------------------------------
+#
+# Three exits can interrupt a run mid-stream: the cooperative
+# preemption path (first signal -> PreemptionRequested -> finalize),
+# an unhandled exception, and the second-signal hard exit. finalize()
+# covers the first; the hooks below cover the other two so the trace
+# gets its JSON terminator and the heartbeat its final beat — an
+# interrupted run's artifacts must always be loadable.
+
+_CRASH_HOOKS = {"installed": False}
+
+
+def flush_artifacts() -> None:
+    """Best-effort drain of the streaming telemetry sinks (idempotent:
+    trace.stop/heartbeat.stop both tolerate repeat calls)."""
+    try:
+        heartbeat.stop()
+    except Exception:
+        logger.debug("heartbeat flush failed", exc_info=True)
+    try:
+        trace.stop()
+    except Exception:
+        logger.debug("trace flush failed", exc_info=True)
+
+
+def install_crash_hooks() -> None:
+    """Arm atexit + excepthook + the second-signal flush (idempotent,
+    once per process; called from the CLI next to interrupt.install)."""
+    if _CRASH_HOOKS["installed"]:
+        return
+    _CRASH_HOOKS["installed"] = True
+    atexit.register(flush_artifacts)
+    prev_hook = sys.excepthook
+
+    def _excepthook(tp, val, tb):
+        flush_artifacts()
+        prev_hook(tp, val, tb)
+
+    sys.excepthook = _excepthook
+    # Second-signal hard exit: only the lock-light heartbeat flush (a
+    # single O_APPEND write); the trace file is already durable per
+    # event and closing it could deadlock inside a signal handler.
+    from galah_tpu.resilience import interrupt
+
+    interrupt.register_flush(heartbeat.flush)

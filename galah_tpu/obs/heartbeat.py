@@ -1,0 +1,274 @@
+"""Periodic liveness heartbeat: ``heartbeat.jsonl`` beside the report.
+
+A daemon thread samples the telemetry registries every
+``GALAH_OBS_HEARTBEAT_S`` seconds (default 0 = off) and durably
+appends one crc-framed record (io/atomic.append_jsonl — the same
+torn-tail-tolerant framing as checkpoints) per beat:
+
+    {"beat": n, "ts": ..., "uptime_s": ..., "occupancy": {stage: v},
+     "gauges": {...}, "counters": {...}, "queue_depths": {stage: n},
+     "flow_items": {stage: n}}
+
+This is the liveness primitive the preemptible-fleet supervisor and
+the index service watch: a run whose heartbeat file stops advancing
+is wedged, one whose occupancy collapses is starving, and a SIGKILL
+mid-write costs exactly one torn record (skipped on read). The
+in-process side keeps bounded per-stage occupancy accumulators
+(min/sum/count/last) so the run report can render an occupancy
+**time-series** summary instead of only the quiesce-time value.
+
+``galah-tpu top <dir>`` renders the newest beat; the CLI starts the
+thread next to the run-report sink and obs.finalize() (plus the
+crash/preemption hooks — obs.install_crash_hooks) stops it with a
+final beat so interrupted runs still carry a last snapshot.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_FILENAME = "heartbeat.jsonl"
+
+# Concurrency contract (GL8xx lint + GalahSan runtime). The module
+# global GLOBAL is unguarded by the same lifecycle argument as
+# trace.RECORDER: start()/stop() run in the single-threaded CLI
+# lifecycle; the beat thread only ever touches its own instance.
+GUARDED_BY = {
+    "Heartbeat._beats": "Heartbeat._lock",
+    "Heartbeat._occ": "Heartbeat._lock",
+    "Heartbeat._final_done": "Heartbeat._lock",
+}
+LOCK_ORDER = ["Heartbeat._lock"]
+
+
+class Heartbeat:
+    """One run's heartbeat writer thread."""
+
+    def __init__(self, directory: str, period_s: float) -> None:
+        os.makedirs(directory or ".", exist_ok=True)
+        self.path = os.path.join(directory or ".", HEARTBEAT_FILENAME)
+        self.period_s = max(0.05, float(period_s))
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._t0 = time.monotonic()
+        self._beats = 0
+        # stage -> [min, sum, count, last] occupancy accumulator
+        self._occ: Dict[str, list] = {}
+        self._final_done = False
+        # sampler thread: only READS the registries (each behind its
+        # own lock); it never emits stage telemetry, so there is no
+        # stage context to adopt.
+        # galah-lint: ignore[GL804] sampler thread emits no telemetry
+        self._thread = threading.Thread(
+            target=self._run, name="galah-heartbeat", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.period_s):
+            try:
+                self.beat()
+            except Exception:  # telemetry never takes down the run
+                logger.debug("heartbeat beat failed", exc_info=True)
+
+    def _gather(self) -> dict:
+        """Sample the registries — OUTSIDE self._lock (metrics/flow
+        take their own locks; GalahSan lock-order discipline)."""
+        from galah_tpu.obs import flow as obs_flow
+        from galah_tpu.obs import metrics as obs_metrics
+        from galah_tpu.obs.report import _OCC_RE
+
+        gauges: Dict[str, float] = {}
+        counters: Dict[str, float] = {}
+        occupancy: Dict[str, float] = {}
+        for name, m in obs_metrics.snapshot().items():
+            kind = m.get("kind")
+            if kind == "counter":
+                counters[name] = m.get("value")
+            elif kind == "gauge":
+                v = m.get("value")
+                if isinstance(v, (int, float)):
+                    gauges[name] = v
+                    match = _OCC_RE.match(name)
+                    if match:
+                        occupancy[match.group(1) or "pipeline"] = v
+        fsnap = obs_flow.snapshot()
+        flow_items = {s: st.get("items", 0)
+                      for s, st in (fsnap.get("stages") or {}).items()}
+        return {
+            "ts": time.time(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "occupancy": occupancy,
+            "gauges": gauges,
+            "counters": counters,
+            "queue_depths": obs_flow.queue_depths(),
+            "flow_items": flow_items,
+        }
+
+    def beat(self) -> None:
+        """Sample + durably append one record (also the final-flush
+        entry point: crash hooks call this directly)."""
+        from galah_tpu.io import atomic
+
+        rec = self._gather()
+        with self._lock:
+            self._beats += 1
+            rec["beat"] = self._beats
+            for stage, v in rec["occupancy"].items():
+                acc = self._occ.get(stage)
+                if acc is None:
+                    self._occ[stage] = [v, v, 1, v]
+                else:
+                    acc[0] = min(acc[0], v)
+                    acc[1] += v
+                    acc[2] += 1
+                    acc[3] = v
+        atomic.append_jsonl(self.path, rec,
+                            site="io.atomic.append[heartbeat]")
+
+    def stop(self, flush: bool = True, join_timeout: float = 5.0) -> None:
+        """Stop the thread; with ``flush`` write one final beat (once,
+        however many of finalize/atexit/excepthook call us)."""
+        self._stop_evt.set()
+        if (self._thread.is_alive()
+                and self._thread is not threading.current_thread()):
+            self._thread.join(timeout=join_timeout)
+        if not flush:
+            return
+        with self._lock:
+            if self._final_done:
+                return
+            self._final_done = True
+        try:
+            self.beat()
+        except Exception:
+            logger.debug("final heartbeat failed", exc_info=True)
+
+    def snapshot(self) -> dict:
+        """Bounded summary for the run report's flow section."""
+        with self._lock:
+            series = {
+                stage: {"min": round(acc[0], 4),
+                        "mean": round(acc[1] / acc[2], 4),
+                        "last": round(acc[3], 4),
+                        "samples": acc[2]}
+                for stage, acc in sorted(self._occ.items())
+            }
+            beats = self._beats
+        return {"period_s": self.period_s, "beats": beats,
+                "path": self.path, "occupancy_series": series}
+
+
+# The active heartbeat, None when GALAH_OBS_HEARTBEAT_S is unset/0.
+GLOBAL: Optional[Heartbeat] = None
+
+
+def start(directory: str, period_s: float) -> Heartbeat:
+    global GLOBAL
+    if GLOBAL is not None:
+        GLOBAL.stop(flush=False)
+    GLOBAL = Heartbeat(directory, period_s)
+    GLOBAL.start()
+    logger.info("Heartbeat every %.3gs -> %s (watch with "
+                "`galah-tpu top %s`)", GLOBAL.period_s, GLOBAL.path,
+                directory or ".")
+    return GLOBAL
+
+
+def maybe_start(report_path: Optional[str]) -> Optional[Heartbeat]:
+    """CLI lifecycle hook: start next to the run-report sink when
+    GALAH_OBS_HEARTBEAT_S > 0 (the flag's default keeps it off)."""
+    try:
+        from galah_tpu.config import env_value
+        period = float(env_value("GALAH_OBS_HEARTBEAT_S") or 0.0)
+    except (TypeError, ValueError):
+        logger.warning("GALAH_OBS_HEARTBEAT_S is not a number; "
+                       "heartbeat disabled")
+        return None
+    if period <= 0:
+        return None
+    directory = os.path.dirname(report_path) if report_path else "."
+    return start(directory or ".", period)
+
+
+def stop(flush: bool = True) -> None:
+    hb = GLOBAL
+    if hb is not None:
+        hb.stop(flush=flush)
+
+
+def flush() -> None:
+    """One immediate beat (signal-path flush: no join, no teardown)."""
+    hb = GLOBAL
+    if hb is not None:
+        try:
+            hb.beat()
+        except Exception:
+            logger.debug("heartbeat flush failed", exc_info=True)
+
+
+def active() -> bool:
+    return GLOBAL is not None
+
+
+def snapshot() -> Optional[dict]:
+    hb = GLOBAL
+    return None if hb is None else hb.snapshot()
+
+
+def reset() -> None:
+    """Drop the active heartbeat without a final beat (tests/run
+    start); the thread is stopped first."""
+    global GLOBAL
+    if GLOBAL is not None:
+        GLOBAL.stop(flush=False)
+    GLOBAL = None
+
+
+def load(directory: str):
+    """(records, torn_count) of a run dir's heartbeat.jsonl — the
+    torn-tail-tolerant read `galah-tpu top` renders from."""
+    from galah_tpu.io import atomic
+    path = directory
+    if os.path.isdir(directory):
+        path = os.path.join(directory, HEARTBEAT_FILENAME)
+    return atomic.read_jsonl(path)
+
+
+def render_latest(directory: str) -> str:
+    """Human rendering of the newest beat (the `galah-tpu top` body)."""
+    path = (os.path.join(directory, HEARTBEAT_FILENAME)
+            if os.path.isdir(directory) else directory)
+    records, torn = load(directory)
+    if not records:
+        return (f"no heartbeat at {path} (run with "
+                "GALAH_OBS_HEARTBEAT_S=<seconds>)\n")
+    rec = records[-1]
+    age = max(0.0, time.time() - float(rec.get("ts") or 0.0))
+    lines = [f"heartbeat {path}",
+             f"  beat {rec.get('beat')}  age {age:.1f}s  uptime "
+             f"{rec.get('uptime_s')}s  ({len(records)} beat(s)"
+             + (f", {torn} torn" if torn else "") + ")"]
+    occ = rec.get("occupancy") or {}
+    if occ:
+        lines.append("  occupancy:")
+        for stage in sorted(occ):
+            v = occ[stage]
+            bar = "#" * int(round(max(0.0, min(1.0, v)) * 20))
+            lines.append(f"    {stage:<10} {v:5.2f} {bar}")
+    depths = rec.get("queue_depths") or {}
+    if depths:
+        lines.append("  queue depths: " + "  ".join(
+            f"{s}={n}" for s, n in sorted(depths.items())))
+    items = rec.get("flow_items") or {}
+    if items:
+        lines.append("  flow items:   " + "  ".join(
+            f"{s}={n}" for s, n in sorted(items.items())))
+    return "\n".join(lines) + "\n"

@@ -233,6 +233,12 @@ def metrics_of_report(report: dict) -> Dict[str, float]:
     hbm = (dc.get("hbm") or {}).get("peak_bytes")
     if isinstance(hbm, (int, float)):
         out["profile.hbm_peak_bytes"] = float(hbm)
+    cp = (report.get("flow") or {}).get("critical_path") or {}
+    for name, blame in (cp.get("stages") or {}).items():
+        for field in ("blame_s", "share"):
+            v = blame.get(field)
+            if isinstance(v, (int, float)):
+                out[f"flow.{name}.{field}"] = float(v)
     return out
 
 

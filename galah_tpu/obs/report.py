@@ -26,7 +26,7 @@ logger = logging.getLogger(__name__)
 
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
                            "run_report.schema.json")
-REPORT_VERSION = 5
+REPORT_VERSION = 6
 
 # disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
 _DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
@@ -199,6 +199,21 @@ def assemble(subcommand: str,
             report["index"] = idx_snap
     except Exception:  # additive section (v5); never lose a report
         logger.debug("index snapshot failed", exc_info=True)
+    try:
+        from galah_tpu.obs import flow as obs_flow
+        from galah_tpu.obs import heartbeat as obs_heartbeat
+
+        flow_snap = obs_flow.snapshot()
+        if flow_snap.get("stages"):
+            flow_snap["critical_path"] = obs_flow.critical_path(
+                flow_snap, report["run"]["duration_s"])
+        hb_snap = obs_heartbeat.snapshot()
+        if hb_snap is not None:
+            flow_snap["heartbeat"] = hb_snap
+        if flow_snap.get("stages") or hb_snap is not None:
+            report["flow"] = flow_snap
+    except Exception:  # additive section (v6); never lose a report
+        logger.debug("flow snapshot failed", exc_info=True)
     if lint is not None:
         report["lint"] = lint
     return report
@@ -330,6 +345,27 @@ def render(report: dict) -> str:
         for stage, v in occ:
             bar = "#" * int(round(max(0.0, min(1.0, v)) * 20))
             lines.append(f"  {stage:<10} {v:5.2f} {bar}")
+    flow_sec = report.get("flow") or {}
+    cp = flow_sec.get("critical_path") or {}
+    if cp.get("stages"):
+        from galah_tpu.obs import flow as obs_flow
+
+        lines += [""] + obs_flow.render_critical_path(cp)
+    hb = flow_sec.get("heartbeat") or {}
+    series = hb.get("occupancy_series") or {}
+    if series:
+        lines += ["",
+                  f"occupancy time-series ({hb.get('beats', 0)} "
+                  f"heartbeat(s) every {hb.get('period_s')}s; "
+                  "min/mean/last):"]
+        for stage in sorted(series):
+            s = series[stage]
+            bar = "#" * int(round(
+                max(0.0, min(1.0, s.get("mean", 0.0))) * 20))
+            lines.append(
+                f"  {stage:<10} {s.get('min', 0.0):.2f}/"
+                f"{s.get('mean', 0.0):.2f}/{s.get('last', 0.0):.2f} "
+                f"{bar}")
     lines += [
         "",
         "resilience:",
@@ -559,6 +595,30 @@ def diff(a: dict, b: dict, label_a: str = "A",
                     "tombstones"):
             va, vb = int(ia.get(key, 0)), int(ib.get(key, 0))
             lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
+
+    # flow drift — additive v6 section, .get throughout. A migrated
+    # bottleneck is THE regression signal the flow layer exists for.
+    fa, fb = a.get("flow"), b.get("flow")
+    if fa is not None or fb is not None:
+        fa, fb = fa or {}, fb or {}
+        ca = fa.get("critical_path") or {}
+        cb = fb.get("critical_path") or {}
+        lines += ["", "flow drift:"]
+        bna, bnb = ca.get("bottleneck"), cb.get("bottleneck")
+        lines.append(f"  bottleneck: {bna} -> {bnb}"
+                     + ("  [MIGRATED]" if bna != bnb else ""))
+        sa_, sb_ = ca.get("stages") or {}, cb.get("stages") or {}
+        for stage in sorted(set(sa_) | set(sb_)):
+            va = int(round(100 * (sa_.get(stage, {}).get("share")
+                                  or 0.0)))
+            vb = int(round(100 * (sb_.get(stage, {}).get("share")
+                                  or 0.0)))
+            lines.append(
+                f"  share[{stage}]: {va}% -> {vb}% ({vb - va:+d}%)")
+        da_ = (fa.get("flows") or {}).get("dropped", 0)
+        db_ = (fb.get("flows") or {}).get("dropped", 0)
+        if da_ or db_:
+            lines.append(f"  dropped flows: {da_} -> {db_}")
 
     la, lb = a.get("lint"), b.get("lint")
     if la is not None or lb is not None:

@@ -16,7 +16,11 @@ What lands in the trace:
     as instant ("ph": "i") events;
   * JAX compile/lowering activity via ``jax.monitoring`` listeners
     ("cat": "jax"), so compile storms are visible on the same timeline
-    as the stages that triggered them.
+    as the stages that triggered them;
+  * flow events ("ph": "s"/"t"/"f", "cat": "flow" — obs/flow.py) tying
+    a pipeline item's producer span to its consumer span across the
+    stage-token-adopting worker threads, so a starved handoff shows up
+    as a long arrow in Perfetto.
 
 This is complementary to --profile-trace-dir (the XLA profiler): that
 captures device timelines below the dispatch boundary; this captures
@@ -96,6 +100,24 @@ class TraceRecorder:
             ev["args"] = args
         self._emit(ev)
 
+    def flow(self, ph: str, name: str, flow_id: int,
+             cat: str = "flow") -> None:
+        """A Chrome flow event: ``ph`` is "s" (start), "t" (step) or
+        "f" (finish). Events sharing (cat, id, name) are drawn as one
+        arrow chain across threads — the producer emits "s" when an
+        item enters a boundary queue, the consumer emits "f" when it
+        dequeues it, and the viewer links the two slices even though
+        they ran on different stage-token-adopting threads."""
+        ev = {"ph": ph, "name": name, "cat": cat, "id": int(flow_id),
+              "pid": self._pid,
+              "tid": threading.get_ident() & 0xFFFFFFFF,
+              "ts": round(self._ts(time.perf_counter()), 3)}
+        if ph == "f":
+            # bind to the enclosing slice's END, so the arrow lands on
+            # the consuming span rather than the next unrelated one
+            ev["bp"] = "e"
+        self._emit(ev)
+
     def close(self) -> None:
         with self._lock:
             if self._closed:
@@ -150,6 +172,13 @@ def emit_instant(name: str, cat: str = "event",
     rec = RECORDER
     if rec is not None:
         rec.instant(name, cat=cat, args=args)
+
+
+def emit_flow(ph: str, name: str, flow_id: int,
+              cat: str = "flow") -> None:
+    rec = RECORDER
+    if rec is not None:
+        rec.flow(ph, name, flow_id, cat=cat)
 
 
 def _install_jax_hooks() -> None:

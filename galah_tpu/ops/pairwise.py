@@ -720,6 +720,9 @@ def iter_threshold_pairs_streamed(
         mesh, n_dev = None, 1
     quantum = row_tile * n_dev
 
+    from galah_tpu.obs import flow as obs_flow
+    from galah_tpu.obs import metrics as obs_metrics
+
     done = np.full((n, sketch_size), np.uint64(SENTINEL),
                    dtype=np.uint64)
     r1 = 0
@@ -728,12 +731,16 @@ def iter_threshold_pairs_streamed(
     wait_s = 0.0
     blocks = iter(blocks_iter)
     while True:
-        t0 = time.monotonic()
-        try:
-            r0, rows = next(blocks)
-        except StopIteration:
-            break
-        wait_s += time.monotonic() - t0
+        # blocked on the upstream sketch stream (obs/flow records it
+        # as the pairs stage's upstream-empty wait)
+        with obs_flow.blocked("pairs", "upstream-empty") as bw:
+            try:
+                r0, rows = next(blocks)
+            except StopIteration:
+                break
+        wait_s += bw.seconds
+        obs_flow.absorb("sketch", "pairs")
+        t_block = time.monotonic()
         bsz = rows.shape[0]
         assert r0 == r1, f"streamed blocks out of order: {r0} != {r1}"
         done[r0:r0 + bsz] = rows
@@ -747,22 +754,23 @@ def iter_threshold_pairs_streamed(
         cols = np.full((block, sketch_size), np.uint64(SENTINEL),
                        dtype=np.uint64)
         cols[:bsz] = rows
-        timing.dispatch()
-        if mesh is not None:
-            from galah_tpu.parallel.mesh import sharded_stripe_stats
+        with obs_flow.blocked("pairs", "device-dispatch") as bdev:
+            timing.dispatch()
+            if mesh is not None:
+                from galah_tpu.parallel.mesh import sharded_stripe_stats
 
-            common, total = sharded_stripe_stats(
-                done[:r1], cols, sketch_size=sketch_size, k=k,
-                mesh=mesh, row_tile=row_tile, r_pad=r_pad)
-        else:
-            jrows = jnp.asarray(
-                np.vstack([done[:r1],
-                           np.full((r_pad - r1, sketch_size),
-                                   np.uint64(SENTINEL), np.uint64)]))
-            common, total = _stripe_stats(
-                jrows, jnp.asarray(cols), sketch_size=sketch_size,
-                k=k, row_tile=row_tile)
-        timing.dispatch(sync=True)
+                common, total = sharded_stripe_stats(
+                    done[:r1], cols, sketch_size=sketch_size, k=k,
+                    mesh=mesh, row_tile=row_tile, r_pad=r_pad)
+            else:
+                jrows = jnp.asarray(
+                    np.vstack([done[:r1],
+                               np.full((r_pad - r1, sketch_size),
+                                       np.uint64(SENTINEL), np.uint64)]))
+                common, total = _stripe_stats(
+                    jrows, jnp.asarray(cols), sketch_size=sketch_size,
+                    k=k, row_tile=row_tile)
+            timing.dispatch(sync=True)
         stripes += 1
 
         common = np.asarray(common).astype(np.int64)
@@ -780,15 +788,25 @@ def iter_threshold_pairs_streamed(
         for a, b, v in zip(ki.tolist(), (r0 + kj).tolist(),
                            ani.tolist()):
             inc[(int(a), int(b))] = float(v)
+        # host post-processing time = stripe wall minus the device
+        # bracket (the upstream wait is already excluded)
+        obs_flow.record_service(
+            "pairs", max(time.monotonic() - t_block - bdev.seconds,
+                         0.0))
+        efid = obs_flow.begin("edge_stripe")
+        obs_flow.emit("pairs", efid)
         yield r1, inc
+        # live gauge refresh (heartbeat samples the time-series)
+        wall_now = time.monotonic() - t_start
+        if wall_now > 0:
+            obs_metrics.pipeline_occupancy(1.0 - wait_s / wall_now,
+                                           stage="pairs")
     if r1 != n:
         raise ValueError(
             f"streamed pair pass saw {r1} rows, expected {n}")
     timing.counter("pairs-streamed-stripes", stripes)
     wall = time.monotonic() - t_start
     if wall > 0 and stripes:
-        from galah_tpu.obs import metrics as obs_metrics
-
         obs_metrics.pipeline_occupancy(1.0 - wait_s / wall,
                                        stage="pairs")
 

@@ -69,6 +69,7 @@ from galah_tpu.ops.pallas_sketch import (
     R_REG,
     fused_sketch_candidates,
 )
+from galah_tpu.obs import flow as obs_flow
 from galah_tpu.utils import timing
 
 #: Max total positions per fused launch. Each position ships
@@ -382,11 +383,13 @@ def _iter_staged(items: Iterator, stage_fn, depth: int = 2):
     pending: deque = deque()
     it = iter(items)
     token = timing.stage_token()
+    ftoken = obs_flow.token()
 
     def staged(item):
         # stage-token adoption: telemetry from the pool thread lands
-        # on the submitting thread's stage, not an empty stack
-        with timing.adopt(token):
+        # on the submitting thread's stage (and flow context), not an
+        # empty stack
+        with timing.adopt(token), obs_flow.adopt(ftoken):
             return stage_fn(item)
 
     def submit_next() -> bool:
@@ -445,6 +448,19 @@ def _iter_fused_sketches(miss_iter, sketch_size, k, seed, algo,
             _demote_fused(RuntimeError("Mosaic lowering failed"))
         for (p, _g), s in zip(buf, sketches):
             yield p, s
+
+
+def _emit_sketch_occupancy(wall: float, wait_s: float,
+                           ingest_s: list) -> float:
+    """Refresh the sketch/ingest occupancy gauges mid-stream (the
+    heartbeat thread samples them into its time-series)."""
+    from galah_tpu.obs import metrics as obs_metrics
+
+    wall = max(wall, 1e-9)
+    occ = 1.0 - wait_s / wall
+    obs_metrics.pipeline_occupancy(occ, stage="sketch")
+    obs_metrics.pipeline_occupancy(sum(ingest_s) / wall, stage="ingest")
+    return occ
 
 
 def iter_path_sketches(
@@ -534,17 +550,26 @@ def iter_path_sketches(
     # to misses, so a single merge walk yields every unique path in
     # original order — the property the overlapped pair pass needs.
     wait_s = 0.0
+    yielded = 0
     for p in dict.fromkeys(paths):
         s = hits.get(p)
         if s is None:
-            tw = time.monotonic()
-            cp, s = next(computed)
             # time blocked on the producer = consumer starvation; the
             # complement is the occupancy the overlap is meant to buy
-            wait_s += time.monotonic() - tw
+            # (obs/flow records it as the sketch stage's
+            # upstream-empty wait for `galah-tpu flow analyze`)
+            with obs_flow.blocked("sketch", "upstream-empty") as bw:
+                cp, s = next(computed)
+            wait_s += bw.seconds
             assert cp == p, f"sketch stream out of order: {cp} != {p}"
             s = store.insert(p, s)
         yield p, s
+        yielded += 1
+        # live gauge refresh so the heartbeat samples a moving
+        # occupancy time-series, not only the quiesce value
+        if bp_total and yielded % 64 == 0:
+            _emit_sketch_occupancy(time.monotonic() - t0, wait_s,
+                                   ingest_s)
 
     wall = max(time.monotonic() - t0, 1e-9)
     if bp_total:
@@ -558,14 +583,15 @@ def iter_path_sketches(
             "workload.ingest_mbp_s",
             help="end-to-end ingest+sketch throughput of the streaming "
                  "sketch stage", unit="Mbp/s").set(bp_total / 1e6 / wall)
-        occ = 1.0 - wait_s / wall
+        occ = _emit_sketch_occupancy(wall, wait_s, ingest_s)
         # the unlabelled gauge keeps its historical meaning (this
         # stage's occupancy) until the overlapped engine overwrites it
         # with the whole-pipeline mean at quiesce (cluster/engine.py)
         obs_metrics.pipeline_occupancy(occ)
-        obs_metrics.pipeline_occupancy(occ, stage="sketch")
-        obs_metrics.pipeline_occupancy(sum(ingest_s) / wall,
-                                       stage="ingest")
+        obs_flow.record_service("sketch", max(wall - wait_s, 0.0),
+                                items=yielded)
+        obs_flow.record_service("ingest", sum(ingest_s),
+                                items=len(ingest_s))
 
 
 def iter_sketch_row_blocks(
@@ -587,8 +613,14 @@ def iter_sketch_row_blocks(
                                     strategy=strategy):
         buf.append(s)
         if len(buf) == block:
-            yield r0, sketch_matrix(buf, sketch_size=store.sketch_size)
+            fid = obs_flow.begin("sketch_block")
+            rows = sketch_matrix(buf, sketch_size=store.sketch_size)
+            obs_flow.emit("sketch", fid)
+            yield r0, rows
             r0 += len(buf)
             buf = []
     if buf:
-        yield r0, sketch_matrix(buf, sketch_size=store.sketch_size)
+        fid = obs_flow.begin("sketch_block")
+        rows = sketch_matrix(buf, sketch_size=store.sketch_size)
+        obs_flow.emit("sketch", fid)
+        yield r0, rows

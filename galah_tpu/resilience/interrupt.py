@@ -68,6 +68,19 @@ _BOUNDARY: Optional[str] = None
 _RESUMED_FROM: Optional[str] = None
 _PRIOR_INTERRUPTIONS = 0
 _PREV_HANDLERS: Dict[int, Any] = {}
+# Last-gasp flush hooks for the second-signal os._exit path (the
+# normal first-signal path drains through obs.finalize instead). Each
+# hook must be signal-safe-ish: bounded, lock-light, exception-proof
+# here regardless. obs.install_crash_hooks() registers the heartbeat
+# flush; the trace file needs none (flushed per event by design).
+_FLUSH_HOOKS: List[Any] = []
+
+
+def register_flush(fn) -> None:
+    """Register a callable run right before the second-signal hard
+    exit (idempotent per callable)."""
+    if fn not in _FLUSH_HOOKS:
+        _FLUSH_HOOKS.append(fn)
 
 
 def _handler(signum, frame) -> None:
@@ -75,9 +88,15 @@ def _handler(signum, frame) -> None:
     if _STOP.is_set():
         # Second signal: the operator/scheduler is done waiting. Die
         # now with the preemption code; durable artifacts are already
-        # crash-consistent by construction.
+        # crash-consistent by construction — the flush hooks just add
+        # one last heartbeat/telemetry record when they can.
         logger.error("second signal %s: exiting immediately (%d)",
                      signame, EXIT_PREEMPTED)
+        for fn in list(_FLUSH_HOOKS):
+            try:
+                fn()
+            except Exception:
+                logger.debug("flush hook failed", exc_info=True)
         os._exit(EXIT_PREEMPTED)
     _SIGNALS.append(signame)
     _STOP.set()

@@ -81,6 +81,7 @@ def main():
 
     from bench import _synth_families
     from galah_tpu.api import generate_galah_clusterer
+    from galah_tpu.obs import flow as obs_flow
     from galah_tpu.obs import metrics as obs_metrics
     from galah_tpu.utils import timing
 
@@ -109,6 +110,7 @@ def main():
             "1" if mode == "overlapped" else "0"
         os.environ.update(_PINS)
         obs_metrics.reset()  # per-run occupancy gauges
+        obs_flow.reset()  # per-run flow graph
         try:
             before = timing.GLOBAL.counters()
             t0 = time.perf_counter()
@@ -141,6 +143,16 @@ def main():
             out["occupancy"] = occ
             out["engaged"] = bool(
                 out["counters"].get("overlap-engaged"))
+            # critical-path blame shares over the overlapped wall —
+            # which stage limits genomes/s (docs/observability.md)
+            fsnap = obs_flow.snapshot()
+            if fsnap.get("stages"):
+                cp = obs_flow.critical_path(fsnap, dt)
+                out["flow"] = {
+                    "bottleneck": cp.get("bottleneck"),
+                    "shares": {s: e["share"]
+                               for s, e in cp["stages"].items()},
+                }
 
     # Overlapped first: its compiles are billed to it.
     for mode in ("overlapped", "serial"):

@@ -170,6 +170,14 @@ run_stage engine_rounds 900 python -u scripts/bench_engine_rounds.py \
 # and lands in its own artifact).
 run_stage e2e_overlap 900 python -u scripts/bench_overlap.py \
   --budget 840
+# Critical-path attribution over the bench stage's run report: which
+# stage owns the e2e wall, as per-stage blame shares (jax-free file
+# math). Soft-warn: bench_overlap prints its own OVERLAP_JSON flow
+# summary either way; a report without flow telemetry (e.g. the stage
+# was skipped under budget) degrades observability, not the session.
+run_stage flow_analyze 120 bash -c \
+  "python -u -m galah_tpu.cli flow analyze '$ART/bench_report.json' \
+   || echo 'flow_analyze: WARN no flow telemetry in bench report (soft)'"
 # Perf gate right after the bench stages: the newest ledger entries
 # (appended by the bench/engine finalizers above) against their
 # same-key median±MAD bands. --soft while hardware history is still

@@ -1,0 +1,29 @@
+"""GL704 fixture: a pipeline-stage module that hand-rolls its queue
+timing instead of emitting flow spans through obs/flow.py."""
+
+import time
+from time import monotonic as mono
+
+# GL704 (anchored here): PIPELINE_STAGE declared, obs.flow never used.
+PIPELINE_STAGE = {
+    "streaming": ["iter_rows"],
+    "occupancy_gauge": "workload.pipeline_occupancy",
+}
+
+
+def iter_rows(blocks):
+    wait_s = 0.0
+    for b in blocks:
+        t0 = time.monotonic()
+        item = next(b)
+        wait_s += time.monotonic() - t0   # GL704 (hand-rolled wait)
+        yield item, wait_s
+
+
+def drain(stream):
+    waited = mono()                       # GL704 (aliased from-import)
+    total_wait = 0.0
+    for _ in stream:
+        total_wait = mono() - waited      # GL704 (plain assign)
+    budget_left = 5.0 - (mono() - waited)  # not a wait name: no finding
+    return total_wait, budget_left

@@ -232,6 +232,48 @@ def test_bad_device_cost_suppression_and_exemptions():
         "bad_device_cost.py", path="galah_tpu/obs/profile.py")) == []
 
 
+def test_bad_flow_fixture_fires_gl704_exact_lines():
+    from galah_tpu.analysis.obs_check import check_obs_file
+
+    src = load_fixture("bad_flow.py",
+                       path="galah_tpu/ops/bad_flow.py")
+    found = check_obs_file(src)
+    gl704 = sorted(f.line for f in found if f.code == "GL704")
+    # the PIPELINE_STAGE anchor (no obs.flow usage at all), the +=
+    # accumulator, the aliased from-import stamp, and the plain
+    # assign; the budget arithmetic on a non-wait name must not fire
+    assert gl704 == [8, 19, 24, 27]
+    assert {f.code for f in found} == {"GL704"}
+    assert all(f.severity is Severity.WARNING for f in found)
+
+
+def test_gl704_scope_is_pipeline_stage_modules_only():
+    from galah_tpu.analysis.obs_check import check_obs_file
+
+    # no PIPELINE_STAGE declaration -> GL704 never fires, even on a
+    # file full of timing sins (those are GL701/702's)
+    src = load_fixture("bad_timing.py",
+                       path="galah_tpu/ops/bad_timing.py")
+    assert not [f for f in check_obs_file(src) if f.code == "GL704"]
+    # outside the GL7xx scope entirely
+    assert check_obs_file(load_fixture(
+        "bad_flow.py", path="scripts/bad_flow.py")) == []
+
+
+def test_gl704_real_pipeline_stage_modules_are_clean():
+    from galah_tpu.analysis.obs_check import check_obs_file
+
+    root = repo_root()
+    for rel in ("galah_tpu/ops/pairwise.py",
+                "galah_tpu/ops/sketch_stream.py",
+                "galah_tpu/cluster/engine.py",
+                "galah_tpu/index/incremental.py"):
+        src = SourceFile.load(str(pathlib.Path(root) / rel))
+        src.path = rel
+        bad = [f for f in check_obs_file(src) if f.code == "GL704"]
+        assert not bad, (rel, [(f.line, f.message) for f in bad])
+
+
 def test_repo_has_no_unsuppressed_adhoc_timing():
     found = [f for f in run_lint(checks=("obs",))
              if not f.suppressed]
@@ -542,7 +584,7 @@ def test_lint_run_report_carries_summary(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(report_path.read_text())
-    assert report["version"] == 5
+    assert report["version"] == 6
     assert report["run"]["subcommand"] == "lint"
     assert set(report["lint"]) == {"errors", "warnings", "notes",
                                    "suppressed", "by_family"}

@@ -1,0 +1,385 @@
+"""Flow tracing + heartbeat telemetry tests (obs/flow, obs/heartbeat).
+
+Covers flow-id minting and propagation across adopted worker threads,
+bounded memory under a 10k-item stream, the critical-path verdict on a
+deliberately starved synthetic pipeline, Chrome-trace s/f flow events,
+heartbeat beats with a torn tail line, the occupancy time-series in
+the run report, and the `galah-tpu flow analyze` / `galah-tpu top`
+subcommands. The whole file runs under GALAH_SAN=1 (conftest arms the
+concurrency sanitizer), so every lock discipline here is
+runtime-checked too.
+"""
+
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from galah_tpu import obs
+from galah_tpu.obs import flow as obs_flow
+from galah_tpu.obs import heartbeat as obs_heartbeat
+from galah_tpu.obs import metrics as obs_metrics
+from galah_tpu.obs import report as report_mod
+from galah_tpu.obs import trace as obs_trace
+from galah_tpu.utils import timing
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    timing.reset()
+    obs.reset_run()
+    yield
+    obs_trace.stop()
+    timing.reset()
+    obs.reset_run()
+
+
+# -- flow ids and the boundary graph --------------------------------
+
+
+def test_flow_ids_monotonic_and_kind_counted():
+    a = obs_flow.begin("genome_batch")
+    b = obs_flow.begin("sketch_block")
+    c = obs_flow.begin("sketch_block")
+    assert 0 < a < b < c
+    snap = obs_flow.snapshot()
+    assert snap["enabled"] is True
+    assert snap["flows"]["created"] == 3
+    assert snap["flows"]["kinds"] == {"genome_batch": 1,
+                                      "sketch_block": 2}
+
+
+def test_disabled_recorder_is_a_noop_but_blocked_still_measures():
+    rec = obs_flow.FlowRecorder(enabled=False)
+    assert rec.begin("sketch_block") == 0
+    rec.emit("sketch", 1)
+    assert rec.absorb("sketch", "pairs") is None
+    with rec.blocked("pairs", "upstream-empty") as b:
+        time.sleep(0.01)
+    assert b.seconds >= 0.005  # occupancy math works with flow off
+    snap = rec.snapshot()
+    assert snap["enabled"] is False and snap["stages"] == {}
+
+
+def test_emit_absorb_records_edge_and_consumer_items():
+    for _ in range(3):
+        fid = obs_flow.begin("sketch_block")
+        obs_flow.emit("sketch", fid)
+    got = [obs_flow.absorb("sketch", "pairs") for _ in range(3)]
+    assert got == [1, 2, 3]  # FIFO order
+    assert obs_flow.absorb("sketch", "pairs") is None  # drained
+    obs_flow.record_service("pairs", 0.5)
+    snap = obs_flow.snapshot()
+    assert snap["edges"] == [{"from": "sketch", "to": "pairs",
+                              "items": 3,
+                              "queue": snap["edges"][0]["queue"]}]
+    assert snap["edges"][0]["queue"]["count"] == 3
+    assert snap["stages"]["pairs"]["items"] == 3
+    assert snap["stages"]["pairs"]["service_s"] == 0.5
+    assert snap["flows"]["completed"] == 3
+
+
+def test_flow_context_propagates_to_adopted_worker_threads():
+    seen = {}
+
+    def worker(tok):
+        with obs_flow.adopt(tok):
+            seen["ctx"] = obs_flow.current()
+            # stage=None resolves via the adopted context
+            obs_flow.record_service(None, 0.25)
+
+    fid = obs_flow.begin("edge_stripe")
+    with obs_flow.span("pairs", fid):
+        tok = obs_flow.token()
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pool.submit(worker, tok).result()
+    assert seen["ctx"] == ("pairs", fid)
+    snap = obs_flow.snapshot()
+    # the worker's 0.25 s plus the span's own service observation
+    assert snap["stages"]["pairs"]["service"]["count"] == 2
+    assert snap["stages"]["pairs"]["service_s"] >= 0.25
+    # outside every span the context is empty again
+    assert obs_flow.current() == (None, None)
+
+
+def test_bounded_memory_under_10k_item_stream():
+    n = 10_000
+    for _ in range(n):
+        obs_flow.emit("sketch", obs_flow.begin("sketch_block"))
+        obs_flow.record_service("sketch", 0.001)
+    snap = obs_flow.snapshot()
+    assert snap["flows"]["created"] == n
+    assert snap["flows"]["dropped"] == n - obs_flow.BOUNDARY_CAP
+    assert obs_flow.queue_depths() == {"sketch": obs_flow.BOUNDARY_CAP}
+    # aggregates stay fixed-size: one histogram, sparse buckets
+    hist = snap["stages"]["sketch"]["service"]
+    assert hist["count"] == n
+    assert len(hist["le_s"]) <= len(obs_flow._BUCKET_EDGES) + 1
+    assert len(json.dumps(snap)) < 20_000  # report-safe payload
+
+
+def test_unknown_blocked_reason_folds_into_host():
+    obs_flow.record_wait("greedy", "cosmic-rays", 1.0)
+    snap = obs_flow.snapshot()
+    assert snap["stages"]["greedy"]["wait_s"] == {"host": 1.0}
+
+
+def test_concurrent_emitters_race_free_under_sanitizer():
+    def hammer(i):
+        for _ in range(200):
+            fid = obs_flow.begin("sketch_block")
+            obs_flow.emit("sketch", fid)
+            obs_flow.absorb("sketch", "pairs")
+            obs_flow.record_service("pairs", 1e-6)
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = obs_flow.snapshot()
+    assert snap["flows"]["created"] == 800
+    from galah_tpu.analysis import sanitizer
+    if sanitizer.GLOBAL.installed:
+        s = sanitizer.GLOBAL.summary()
+        assert s["races"] == 0 and s["inversions"] == 0
+
+
+# -- chrome-trace flow events ---------------------------------------
+
+
+def test_trace_carries_s_t_f_flow_events(tmp_path):
+    path = tmp_path / "trace.json"
+    obs_trace.start(str(path))
+    fid = obs_flow.begin("sketch_block")
+    obs_flow.emit("sketch", fid)
+    with obs_flow.span("pairs", fid):
+        pass
+    obs_flow.absorb("sketch", "pairs")
+    obs_trace.stop()
+    events = json.loads(path.read_text())
+    flows = [e for e in events if e.get("cat") == "flow"
+             and e.get("ph") in ("s", "t", "f")]
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert all(e["id"] == fid for e in flows)
+    assert flows[-1]["bp"] == "e"  # bind to enclosing slice
+
+
+# -- critical path ---------------------------------------------------
+
+
+def _starved_pipeline_snapshot():
+    """Synthetic starved pipeline: sketch is slow (8 s service), pairs
+    and greedy mostly sit in upstream-empty waits."""
+    rec = obs_flow.FlowRecorder(enabled=True)
+    rec.record_service("ingest", 0.5, items=10)
+    rec.record_service("sketch", 8.0, items=10)
+    rec.record_wait("sketch", "upstream-empty", 0.5)
+    rec.record_service("pairs", 0.6, items=10)
+    rec.record_wait("pairs", "upstream-empty", 8.0)
+    rec.record_wait("pairs", "device-dispatch", 0.4)
+    rec.record_service("greedy", 0.5)
+    rec.record_wait("greedy", "upstream-empty", 9.0)
+    for _ in range(10):
+        rec.emit("ingest", rec.begin("genome_batch"))
+        rec.absorb("ingest", "sketch")
+        rec.emit("sketch", rec.begin("sketch_block"))
+        rec.absorb("sketch", "pairs")
+        rec.emit("pairs", rec.begin("edge_stripe"))
+        rec.absorb("pairs", "greedy")
+    return rec.snapshot()
+
+
+def test_critical_path_blames_the_starving_producer():
+    snap = _starved_pipeline_snapshot()
+    cp = obs_flow.critical_path(snap, 10.0)
+    assert cp["bottleneck"] == "sketch"
+    shares = {s: e["share"] for s, e in cp["stages"].items()}
+    assert shares["sketch"] == max(shares.values())
+    assert shares["sketch"] > 0.5
+    # conservation: blame shares sum to the e2e wall (>= 95% is the
+    # acceptance bar; the pure decomposition is exact)
+    total = sum(e["blame_s"] for e in cp["stages"].values())
+    assert total == pytest.approx(10.0, rel=1e-6)
+    assert sum(shares.values()) == pytest.approx(1.0, rel=1e-6)
+
+
+def test_critical_path_renders_with_coverage_line():
+    cp = obs_flow.critical_path(_starved_pipeline_snapshot(), 10.0)
+    lines = obs_flow.render_critical_path(cp)
+    assert lines[0].startswith("flow critical path")
+    assert "bottleneck: sketch" in lines[1]
+    assert any("blame shares cover 100% of the e2e wall" in ln
+               for ln in lines)
+
+
+def test_critical_path_empty_and_zero_wall_are_safe():
+    assert obs_flow.critical_path({}, 10.0)["stages"] == {}
+    snap = _starved_pipeline_snapshot()
+    assert obs_flow.critical_path(snap, 0.0)["stages"] == {}
+    lines = obs_flow.render_critical_path(
+        obs_flow.critical_path({}, 0.0))
+    assert any("no flow data" in ln for ln in lines)
+
+
+# -- heartbeat -------------------------------------------------------
+
+
+def test_heartbeat_beats_and_survives_a_torn_tail(tmp_path):
+    obs_metrics.pipeline_occupancy(0.8, stage="sketch")
+    hb = obs_heartbeat.start(str(tmp_path), 0.05)
+    deadline = time.monotonic() + 5.0
+    while hb.snapshot()["beats"] < 3:
+        assert time.monotonic() < deadline, "heartbeat never beat"
+        time.sleep(0.01)
+    obs_heartbeat.stop()
+    records, torn = obs_heartbeat.load(str(tmp_path))
+    assert torn == 0 and len(records) >= 3
+    assert records[-1]["beat"] == len(records)
+    assert records[-1]["occupancy"]["sketch"] == 0.8
+    # a run SIGKILLed mid-append leaves a torn tail: must read as one
+    # record short, never an error
+    with open(hb.path, "a") as fh:
+        fh.write('{"beat": 99, "truncat')
+    records2, torn2 = obs_heartbeat.load(str(tmp_path))
+    assert len(records2) == len(records) and torn2 == 1
+    page = obs_heartbeat.render_latest(str(tmp_path))
+    assert "occupancy:" in page and "sketch" in page
+    assert "1 torn" in page
+
+
+def test_heartbeat_final_beat_is_written_once(tmp_path):
+    hb = obs_heartbeat.start(str(tmp_path), 30.0)  # never fires alone
+    obs_heartbeat.stop()
+    obs_heartbeat.stop()  # idempotent: atexit + finalize both call it
+    obs.flush_artifacts()
+    records, _ = obs_heartbeat.load(str(tmp_path))
+    assert len(records) == 1  # exactly one final flush beat
+
+
+def test_heartbeat_occupancy_time_series_min_mean_last(tmp_path):
+    hb = obs_heartbeat.Heartbeat(str(tmp_path), 60.0)
+    for v in (0.2, 0.6, 1.0):
+        obs_metrics.pipeline_occupancy(v, stage="pairs")
+        hb.beat()
+    series = hb.snapshot()["occupancy_series"]["pairs"]
+    assert series == {"min": 0.2, "mean": 0.6, "last": 1.0,
+                      "samples": 3}
+
+
+def test_maybe_start_honors_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("GALAH_OBS_HEARTBEAT_S", raising=False)
+    assert obs_heartbeat.maybe_start(str(tmp_path / "r.json")) is None
+    monkeypatch.setenv("GALAH_OBS_HEARTBEAT_S", "0")
+    assert obs_heartbeat.maybe_start(str(tmp_path / "r.json")) is None
+    monkeypatch.setenv("GALAH_OBS_HEARTBEAT_S", "30")
+    hb = obs_heartbeat.maybe_start(str(tmp_path / "r.json"))
+    assert hb is not None
+    assert hb.path == str(tmp_path / "heartbeat.jsonl")
+    obs_heartbeat.stop(flush=False)
+
+
+def test_top_subcommand_renders_and_signals_missing(tmp_path):
+    from galah_tpu.cli import main
+
+    assert main(["top", str(tmp_path)]) == 1  # no heartbeat yet
+    hb = obs_heartbeat.Heartbeat(str(tmp_path), 60.0)
+    obs_metrics.pipeline_occupancy(0.4, stage="greedy")
+    hb.beat()
+    assert main(["top", str(tmp_path)]) == 0
+    assert main(["top", hb.path]) == 0  # direct file path works too
+
+
+# -- run report v6 + flow analyze ------------------------------------
+
+
+def _report_with_flow(tmp_path, name="run_report.json"):
+    fid = obs_flow.begin("sketch_block")
+    obs_flow.emit("sketch", fid)
+    obs_flow.absorb("sketch", "pairs")
+    obs_flow.record_service("sketch", 2.0, items=1)
+    obs_flow.record_wait("pairs", "upstream-empty", 1.5)
+    obs_flow.record_service("pairs", 0.5)
+    rep = report_mod.assemble("cluster", started_at=0.0)
+    path = tmp_path / name
+    report_mod.write(str(path), rep)
+    return rep, str(path)
+
+
+def test_report_v6_carries_flow_section_and_validates(tmp_path):
+    rep, _ = _report_with_flow(tmp_path)
+    assert rep["version"] == 6
+    flow = rep["flow"]
+    assert flow["stages"]["pairs"]["items"] == 1
+    cp = flow["critical_path"]
+    assert cp["e2e_wall_s"] == pytest.approx(
+        rep["run"]["duration_s"], rel=1e-6)
+    assert set(cp["stages"]) == {"sketch", "pairs"}
+    assert report_mod.validate(rep) == []
+    jsonschema = pytest.importorskip("jsonschema")
+    with open(report_mod.SCHEMA_PATH) as fh:
+        jsonschema.Draft7Validator(json.load(fh)).validate(rep)
+    page = report_mod.render(rep)
+    assert "flow critical path" in page
+
+
+def test_report_includes_heartbeat_series(tmp_path):
+    hb = obs_heartbeat.start(str(tmp_path), 60.0)
+    obs_metrics.pipeline_occupancy(0.3, stage="sketch")
+    hb.beat()
+    obs_flow.record_service("sketch", 1.0)
+    rep = report_mod.assemble("cluster", started_at=0.0)
+    series = rep["flow"]["heartbeat"]["occupancy_series"]
+    assert series["sketch"]["last"] == 0.3
+    page = report_mod.render(rep)
+    assert "occupancy time-series" in page
+
+
+def test_report_diff_shows_flow_drift(tmp_path):
+    rep, _ = _report_with_flow(tmp_path)
+    rep2 = json.loads(json.dumps(rep))
+    cp2 = rep2["flow"]["critical_path"]
+    cp2["bottleneck"] = "greedy"
+    cp2["stages"]["pairs"]["share"] = 0.9
+    out = report_mod.diff(rep, rep2)
+    assert "flow drift:" in out
+    assert "MIGRATED" in out
+
+
+def test_flow_analyze_subcommand(tmp_path, capsys):
+    from galah_tpu.cli import main
+
+    _, path = _report_with_flow(tmp_path)
+    assert main(["flow", "analyze", path]) == 0
+    out = capsys.readouterr().out
+    assert "flow critical path" in out and "bottleneck:" in out
+    assert main(["flow", "analyze", path, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert "bottleneck" in parsed and "stages" in parsed
+    assert main(["flow", "analyze", str(tmp_path / "nope.json")]) == 1
+
+
+def test_flow_analyze_rejects_flowless_report(tmp_path):
+    from galah_tpu.cli import main
+
+    obs.reset_run()  # no flow activity at all
+    rep = report_mod.assemble("cluster", started_at=0.0)
+    assert "flow" not in rep or not rep["flow"].get("stages")
+    path = tmp_path / "bare.json"
+    report_mod.write(str(path), rep)
+    assert main(["flow", "analyze", str(path)]) == 1
+
+
+def test_flow_metrics_feed_the_perf_ledger(tmp_path):
+    from galah_tpu.obs import ledger as ledger_mod
+
+    rep, _ = _report_with_flow(tmp_path)
+    metrics = ledger_mod.metrics_of_report(rep)
+    assert "flow.sketch.blame_s" in metrics
+    assert "flow.pairs.share" in metrics
+    total = sum(v for k, v in metrics.items()
+                if k.startswith("flow.") and k.endswith(".blame_s"))
+    assert total == pytest.approx(rep["run"]["duration_s"], rel=1e-6)
