@@ -65,6 +65,8 @@ STRATEGY_MODULES = (
     "galah_tpu/ops/pallas_fragment.py",
     "galah_tpu/ops/greedy_select.py",
     "galah_tpu/ops/sketch_stream.py",
+    "galah_tpu/ops/bucketing.py",
+    "galah_tpu/parallel/mesh.py",
 )
 
 _WHERE_CALLS = frozenset({

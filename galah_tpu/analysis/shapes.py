@@ -98,6 +98,16 @@ def _lattice() -> List[Tuple[str, str, Callable[[], object],
         add("pairwise.tile_intersect_counts", case, tile_icount,
             sds((br, k), u64), sds((bc, k), u64))
 
+    # 2D-mesh tile wrapper: same stats as pairwise.tile_stats but with
+    # the int32 output contract the lattice assembler depends on (no
+    # new Pallas kernel — the 2D path reuses the 1-D tile kernels, so
+    # Mosaic coverage is inherited from the rows above)
+    tile2d = get("galah_tpu.parallel.mesh", "tile2d_stats")
+    for br, bc, k in ((8, 128, 1000), (16, 256, 1024)):
+        add("mesh.tile2d_stats", f"br={br},bc={bc},K={k},uint64",
+            tile2d, sds((br, k), u64), sds((bc, k), u64),
+            sketch_size=k, k=21)
+
     # Mosaic pairwise tiles: tracing cost scales with the unrolled
     # chunk count (~25 s at K=1000), so the lattice pins padding
     # behavior at small widths — on-quantum, off-quantum (K=200 pads

@@ -195,18 +195,23 @@ class MinHashPreclusterer(PreclusterBackend):
         )
         from galah_tpu.parallel import distributed
 
+        from galah_tpu.ops.bucketing import bucketing_engaged
+
         n = len(genome_paths)
         strategy, _ = resolve_sketch_strategy()
         if (distributed.process_count() > 1
                 or strategy == "c"
                 or n >= sparse_screen_min_n()
-                or len(dict.fromkeys(genome_paths)) != n):
+                or len(dict.fromkeys(genome_paths)) != n
+                # the bucketed pair pass needs every HLL cardinality
+                # up front — streaming cannot band a prefix
+                or bucketing_engaged(n)):
             return None
         mesh = None
         if jax.device_count() > 1:
-            from galah_tpu.parallel.mesh import make_mesh
+            from galah_tpu.parallel.mesh import auto_mesh
 
-            mesh = make_mesh()
+            mesh = auto_mesh()
         logger.info(
             "Streaming %d genomes: ingest+sketch overlapped with the "
             "pair pass (strategy %s) ..", n, strategy)
@@ -236,6 +241,28 @@ class MinHashPreclusterer(PreclusterBackend):
             out.update(inc)
         return out
 
+    def _hll_cardinalities(self, genome_paths: Sequence[str]):
+        """(n,) f64 HLL cardinality estimates for the bucketed pair
+        pass, through the same disk-cache kind ('hll') the dashing
+        backend uses — registers are ~4 KB per genome at p=12 and the
+        linear sketch pass is amortized against the O(N^2) lattice it
+        prunes."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from galah_tpu.backends.hll_backend import HLLPreclusterer
+        from galah_tpu.ops import hll as hll_ops
+
+        h = HLLPreclusterer(
+            min_ani=self.min_ani, k=self.k, seed=self.store.seed,
+            hash_algo=self.store.algo, cache=self.store.cache,
+            threads=self.threads)
+        by_path = h._sketch_paths(list(dict.fromkeys(genome_paths)))
+        regs = np.stack([by_path[p] for p in genome_paths])
+        return np.asarray(
+            hll_ops.hll_cardinality(jnp.asarray(regs)),
+            dtype=np.float64), h.p
+
     def distances(self, genome_paths: Sequence[str]) -> PairDistanceCache:
         pairs = self._streamed_pair_pass(genome_paths)
         if pairs is not None:
@@ -259,6 +286,33 @@ class MinHashPreclusterer(PreclusterBackend):
                 sketches = [by_path[p] for p in genome_paths]
                 mat = sketch_matrix(sketches,
                                     sketch_size=self.sketch_size)
+        from galah_tpu.ops.bucketing import (
+            bucketed_threshold_pairs,
+            bucketing_engaged,
+        )
+        from galah_tpu.parallel import distributed as _dist
+
+        if (bucketing_engaged(len(genome_paths))
+                and _dist.process_count() == 1):
+            # Hierarchical precluster: HLL cardinality bands prune the
+            # pair lattice before any MinHash screening; the kept pair
+            # dict is bit-identical to the unbucketed pass
+            # (ops/bucketing.py has the conservativeness argument).
+            logger.info("Computing cardinality-bucketed all-pairs "
+                        "Mash ANI ..")
+            with timing.stage("precluster-hll-cards"):
+                cards, hll_p = self._hll_cardinalities(genome_paths)
+            with timing.stage("pairwise-minhash"):
+                pairs = bucketed_threshold_pairs(
+                    mat, cards, k=self.k, min_ani=self.min_ani,
+                    sketch_size=self.sketch_size, p=hll_p)
+            cache = PairDistanceCache()
+            for (i, j), ani in pairs.items():
+                cache.insert((i, j), ani)
+            logger.info(
+                "Found %d pairs passing precluster threshold %.4f",
+                len(cache), self.min_ani)
+            return cache
         logger.info("Computing tiled all-pairs Mash ANI ..")
         with timing.stage("pairwise-minhash"):
             # threshold_pairs auto-selects the column-sharded SPMD

@@ -156,6 +156,27 @@ def cluster(
                  "(GALAH_TPU_INGEST_DEPTH or max(2, threads))").set(
             float(ingest_depth(int(ingest_threads))))
 
+    # Bucketed pair-pass entry: record whether the HLL cardinality
+    # bands prune this run's lattice (the preclusterer routes itself;
+    # the gauge keys the funnel and the perf-report narrative).
+    from galah_tpu.ops.bucketing import (
+        bucketing_engaged,
+        resolve_hll_buckets,
+    )
+
+    hll_buckets = (bucketing_engaged(len(genomes))
+                   and preclusterer.method_name() == "finch")
+    obs_metrics.gauge(
+        "workload.hll_buckets",
+        help="1 when the HLL cardinality-bucketed precluster pass is "
+             "engaged for this run (GALAH_TPU_HLL_BUCKETS)").set(
+        float(hll_buckets))
+    if hll_buckets:
+        logger.info(
+            "HLL cardinality bucketing engaged for the precluster "
+            "pair pass (GALAH_TPU_HLL_BUCKETS=%s)",
+            resolve_hll_buckets())
+
     pre_cache = checkpoint.load_distances() if checkpoint else None
     overlap_state = None
     if pre_cache is None:

@@ -182,6 +182,25 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "fragment-ANI batch launches in the overlapped "
               "dataflow; bounds the in-flight window (memory stays "
               "O(depth))"),
+    Flag("GALAH_TPU_MESH_SHAPE", section="kernel", default="auto",
+         help="Device-mesh geometry for the all-pairs distance passes "
+              "(docs/DISTRIBUTED.md): 'auto' picks the squarest RxC "
+              "factorization of the device count (communication-"
+              "avoiding 2D tiling — each sketch row is replicated "
+              "along one mesh row and one mesh column instead of to "
+              "every device), '1d' pins the single-axis mesh, and an "
+              "explicit 'RxC' (e.g. '2x4') pins that shape. A shape "
+              "that does not factor the device count demotes to 1-D "
+              "with a mesh-demoted event"),
+    Flag("GALAH_TPU_HLL_BUCKETS", section="kernel", default="auto",
+         choices=("auto", "0", "1"),
+         help="HLL cardinality-bucketed hierarchical precluster "
+              "(docs/DISTRIBUTED.md): bucket genomes into overlapping "
+              "log-cardinality bands sized so no pair that could "
+              "reach the precluster threshold lands in disjoint "
+              "bands, and schedule only same- and adjacent-band "
+              "pairs. auto engages above the sparse-screen crossover; "
+              "1 forces it at any N; 0 disables it"),
     Flag("GALAH_TPU_PALLAS_HASH", kind="bool", section="kernel",
          help="1 forces the quarantined Mosaic murmur3 kernel, 0 "
               "forces the XLA u64 emulation; unset uses the "

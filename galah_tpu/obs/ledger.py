@@ -178,15 +178,16 @@ def workload_fingerprint(report: dict) -> Dict[str, Optional[int]]:
 
 def strategy_fingerprint(report: dict) -> str:
     """The pinned-strategy tuple (pairlist/fragment/greedy/sketch/
-    overlap), 'auto' where unpinned — a pinned run must not share a
-    noise band with an AUTO run (and a forced-overlap run must not
-    share one with a stage-serial run)."""
+    overlap/mesh-shape), 'auto' where unpinned — a pinned run must not
+    share a noise band with an AUTO run (and a 2x4-mesh run must not
+    share one with a 1-D run)."""
     parts = []
     for flag in ("GALAH_TPU_PAIRLIST_STRATEGY",
                  "GALAH_TPU_FRAGMENT_STRATEGY",
                  "GALAH_TPU_GREEDY_STRATEGY",
                  "GALAH_TPU_SKETCH_STRATEGY",
-                 "GALAH_TPU_OVERLAP"):
+                 "GALAH_TPU_OVERLAP",
+                 "GALAH_TPU_MESH_SHAPE"):
         parts.append(_flag_value(report, flag) or "auto")
     return "/".join(parts)
 

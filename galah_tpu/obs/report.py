@@ -338,6 +338,21 @@ def render(report: dict) -> str:
         + (f" ({100.0 * hit_rate:.0f}% hit rate)"
            if hit_rate is not None else ""),
     ]
+    mets = report.get("metrics", {})
+    pruned = (mets.get("precluster.bucket_pruned_pairs") or {}) \
+        .get("value")
+    if pruned is not None:
+        frac = (mets.get("precluster.bucket_pruned_fraction") or {}) \
+            .get("value") or 0.0
+        bands = (mets.get("precluster.bucket_count") or {}) \
+            .get("value") or 0
+        lines.append(
+            f"  HLL-band prefilter: {int(pruned)} pairs pruned "
+            f"({100.0 * frac:.0f}% of lattice, {int(bands)} band(s))")
+    dcn = (mets.get("mesh.dcn_bytes_per_row") or {}).get("value")
+    if dcn is not None:
+        lines.append(
+            f"  mesh DCN model:     {int(dcn)} bytes/row replicated")
     occ = _occupancy_rows(report.get("metrics", {}))
     if occ:
         lines += ["", "pipeline occupancy (busy fraction of stage "

@@ -1,0 +1,209 @@
+"""HLL cardinality-bucketed hierarchical precluster (GALAH_TPU_HLL_BUCKETS).
+
+The all-pairs precluster pass schedules the full O(N^2) lattice even
+though most pairs cannot possibly reach the threshold: a pair's true
+Jaccard is containment-limited,
+
+    J(A, B) = |A n B| / |A u B| <= min(|A|, |B|) / max(|A|, |B|),
+
+so two genomes whose k-mer cardinalities differ by more than the
+threshold ratio can never pass. Bucketing genomes into overlapping
+log-cardinality bands and scheduling only same- and adjacent-band tile
+pairs prunes the rest of the lattice BEFORE any MinHash screening —
+the 1M-genome regime never materializes the full lattice.
+
+The band width is provably conservative for the pipeline's own
+decisions (docs/DISTRIBUTED.md has the full derivation):
+
+  * the pair decision is the SKETCH Jaccard (common/total >= j_thr
+    with j_thr = ani_to_jaccard(min_ani, k)); the bottom-k estimate
+    concentrates around the true J with std error sqrt(J(1-J)/K), so
+    a pair that can pass satisfies J >= j_lo := j_thr - 6*sqrt(
+    j_thr*(1-j_thr)/K);
+  * HLL cardinality estimates carry relative std error sigma =
+    1.04/sqrt(2^p) (~1.6% at p=12); padding by delta = 6*sigma bounds
+    the estimate ratio: chat_A/chat_B >= j_lo * (1-delta)/(1+delta);
+  * therefore every admissible pair satisfies
+    |ln chat_A - ln chat_B| <= L := ln(1/j_lo) + ln((1+delta)/(1-delta)),
+    and with band(g) = floor(ln chat_g / L) it lands within one band
+    of itself: |band(A) - band(B)| <= 1.
+
+Exact cover without duplicates: for each band b the submatrix S_b is
+members(b) + members(b+1) in ascending global order; the pair pass
+runs over S_b and only pairs with >= 1 endpoint in band b are kept
+(pairs inside band b+1 are covered by S_{b+1}'s run). Every admissible
+pair is evaluated exactly once with the SAME per-pair integer stats as
+the full pass, so the pair set is bit-identical to bucketing off.
+
+When the margins degenerate (j_lo <= 0 at tiny sketch sizes) the band
+width is infinite — one band, zero pruning, still exact.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: The bucketed pass must return the exact pair dict of the unbucketed
+#: pass: band assignment is pure f64 host math and every scheduled
+#: pair's ANI comes from the unchanged per-pair integer stats.
+DETERMINISM_CONTRACT = {
+    "family": "bucketing",
+    "dtype": "float64",
+    "functions": ["band_width", "assign_bands",
+                  "bucketed_threshold_pairs"],
+}
+
+#: 6-sigma margins on both estimators keep the filter conservative far
+#: beyond any plausible corpus size (per-pair miss odds ~1e-9).
+_SIGMAS = 6.0
+
+
+def resolve_hll_buckets() -> str:
+    """The GALAH_TPU_HLL_BUCKETS flag value ('auto' | '0' | '1')."""
+    from galah_tpu.config import env_value
+
+    return (env_value("GALAH_TPU_HLL_BUCKETS") or "auto").strip()
+
+
+def bucketing_engaged(n: int) -> bool:
+    """Whether the cardinality-bucketed precluster pass should run for
+    an n-genome workload: forced on ('1'), forced off ('0'), or AUTO —
+    on above the sparse-screen crossover (the same large-N regime
+    where materializing the full lattice starts to hurt)."""
+    raw = resolve_hll_buckets()
+    if raw == "0":
+        return False
+    if raw == "1":
+        return n >= 2
+    from galah_tpu.ops.collision import sparse_screen_min_n
+
+    return n >= sparse_screen_min_n()
+
+
+def band_width(min_ani: float, k: int, p: int,
+               sketch_size: int) -> float:
+    """Log-cardinality band width L (see module docstring); inf when
+    the MinHash margin swallows the threshold (no safe pruning)."""
+    from galah_tpu.ops.pairwise import ani_to_jaccard
+
+    j_thr = float(ani_to_jaccard(min_ani, k))
+    eps_mh = _SIGMAS * math.sqrt(
+        j_thr * (1.0 - j_thr) / float(sketch_size))
+    j_lo = j_thr - eps_mh
+    if j_lo <= 0.0:
+        return math.inf
+    delta = _SIGMAS * 1.04 / math.sqrt(float(1 << p))
+    if delta >= 1.0:
+        return math.inf
+    return (-math.log(j_lo)
+            + math.log((1.0 + delta) / (1.0 - delta)))
+
+
+def assign_bands(cards: np.ndarray, min_ani: float, k: int, p: int,
+                 sketch_size: int) -> np.ndarray:
+    """Band index per genome from its HLL cardinality estimate. An
+    infinite band width (degenerate margins) puts everything in band
+    0 — exact, just unpruned."""
+    width = band_width(min_ani, k, p, sketch_size)
+    c = np.maximum(np.asarray(cards, dtype=np.float64), 1.0)
+    if not math.isfinite(width):
+        return np.zeros(c.shape[0], dtype=np.int64)
+    return np.floor(np.log(c) / width).astype(np.int64)
+
+
+def _pair_counts(bands: np.ndarray) -> Tuple[int, int]:
+    """(possible, scheduled) pair counts for the funnel gauges."""
+    n = int(bands.shape[0])
+    possible = n * (n - 1) // 2
+    uniq, counts = np.unique(bands, return_counts=True)
+    by_band = dict(zip(uniq.tolist(), counts.tolist()))
+    scheduled = 0
+    for b, m_b in by_band.items():
+        m_next = by_band.get(b + 1, 0)
+        s = m_b + m_next
+        # pairs of S_b with >= 1 endpoint in band b (the kept set)
+        scheduled += s * (s - 1) // 2 - m_next * (m_next - 1) // 2
+    return possible, scheduled
+
+
+def bucketed_threshold_pairs(
+    sketch_mat: np.ndarray,
+    cards: np.ndarray,
+    k: int,
+    min_ani: float,
+    sketch_size: Optional[int] = None,
+    p: int = 12,
+    pair_pass: Optional[Callable[[np.ndarray], dict]] = None,
+) -> Dict[Tuple[int, int], float]:
+    """threshold_pairs with the cardinality-band prefilter: identical
+    {(i, j): ani} pair dict, only same- and adjacent-band submatrices
+    ever scheduled. `cards` is the per-genome HLL cardinality estimate
+    aligned with `sketch_mat` rows; `pair_pass` (default
+    ops/pairwise.threshold_pairs) maps a row-subset matrix to its
+    local pair dict and is free to route to the C / sparse / 1-D / 2D
+    mesh implementations — every one is per-pair exact."""
+    from galah_tpu.obs import events, metrics as obs_metrics
+
+    n = sketch_mat.shape[0]
+    eff_size = (sketch_size if sketch_size is not None
+                else sketch_mat.shape[1])
+    if pair_pass is None:
+        from galah_tpu.ops.pairwise import threshold_pairs
+
+        def pair_pass(sub):
+            return threshold_pairs(sub, k=k, min_ani=min_ani,
+                                   sketch_size=eff_size)
+
+    bands = assign_bands(cards, min_ani, k, p, eff_size)
+    possible, scheduled = _pair_counts(bands)
+    pruned = possible - scheduled
+
+    members: Dict[int, np.ndarray] = {
+        int(b): np.nonzero(bands == b)[0]
+        for b in np.unique(bands).tolist()}
+
+    out: Dict[Tuple[int, int], float] = {}
+    for b in sorted(members):
+        own = members[b]
+        nxt = members.get(b + 1)
+        idx = (own if nxt is None
+               else np.sort(np.concatenate([own, nxt])))
+        if idx.shape[0] < 2:
+            continue
+        in_b = set(own.tolist())
+        sub = pair_pass(np.ascontiguousarray(sketch_mat[idx]))
+        for (a, bb), ani in sub.items():
+            ga, gb = int(idx[a]), int(idx[bb])
+            # within-(b+1) pairs belong to S_{b+1}'s run
+            if ga in in_b or gb in in_b:
+                out[(ga, gb)] = ani
+
+    n_bands = len(members)
+    obs_metrics.gauge(
+        "precluster.bucket_pruned_pairs",
+        help="Candidate pairs the HLL cardinality-band prefilter "
+             "removed from the all-pairs schedule (last precluster "
+             "pass)", unit="pairs").set(float(pruned))
+    obs_metrics.gauge(
+        "precluster.bucket_pruned_fraction",
+        help="Fraction of the full pair lattice the cardinality-band "
+             "prefilter pruned (last precluster pass)",
+        unit="fraction").set(
+        float(pruned) / possible if possible else 0.0)
+    obs_metrics.gauge(
+        "precluster.bucket_count",
+        help="Non-empty HLL cardinality bands in the last bucketed "
+             "precluster pass", unit="bands").set(float(n_bands))
+    events.record("hll-buckets", bands=n_bands, possible=possible,
+                  scheduled=scheduled, pruned=pruned)
+    logger.info(
+        "HLL cardinality bucketing: %d bands, %d/%d candidate pairs "
+        "pruned (%.1f%%)", n_bands, pruned, possible,
+        100.0 * pruned / possible if possible else 0.0)
+    return out

@@ -289,9 +289,9 @@ def hll_threshold_pairs(
     # explicit mesh) pins the single-device implementation so kernel
     # parity tests and single-chip callers get what they asked for.
     if mesh is None and use_pallas is None and jax.device_count() > 1:
-        from galah_tpu.parallel.mesh import make_mesh
+        from galah_tpu.parallel.mesh import auto_mesh
 
-        mesh = make_mesh()
+        mesh = auto_mesh()
     if mesh is not None and mesh.devices.size > 1:
         # Multi-device runtime: the column-sharded SPMD extraction
         # (parallel/mesh.py) covers the mesh with one dispatch per row

@@ -394,9 +394,9 @@ def screen_pairs(
         return list(zip(pi[keep].tolist(), pj[keep].tolist()))
 
     if mesh is None and jax.device_count() > 1:
-        from galah_tpu.parallel.mesh import make_mesh
+        from galah_tpu.parallel.mesh import auto_mesh
 
-        mesh = make_mesh()
+        mesh = auto_mesh()
     if mesh is not None and mesh.devices.size > 1:
         from galah_tpu.parallel.mesh import sharded_screen_pairs
 
@@ -557,9 +557,9 @@ def threshold_pairs(
     # use_pallas (True OR False) pins the single-device implementation,
     # as does an explicit mesh.
     if mesh is None and use_pallas is None and jax.device_count() > 1:
-        from galah_tpu.parallel.mesh import make_mesh
+        from galah_tpu.parallel.mesh import auto_mesh
 
-        mesh = make_mesh()
+        mesh = auto_mesh()
     if mesh is not None and mesh.devices.size > 1:
         from galah_tpu.parallel.mesh import sharded_threshold_pairs
 

@@ -1,6 +1,9 @@
 from galah_tpu.parallel import distributed  # noqa: F401
 from galah_tpu.parallel.mesh import (  # noqa: F401
+    auto_mesh,
     make_mesh,
+    make_mesh_2d,
+    resolve_mesh_shape,
     sharded_pair_count,
     sharded_threshold_pairs,
 )

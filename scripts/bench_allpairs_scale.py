@@ -1,0 +1,161 @@
+"""All-pairs scaling: 1-D vs 2D tiled mesh, with the HLL
+cardinality-band prefilter's pruning fraction.
+
+The 2D tiled mesh (GALAH_TPU_MESH_SHAPE, parallel/mesh.py) replicates
+each sketch row only along its mesh row and column — (r-1)+(c-1)
+interconnect crossings instead of the 1-D mesh's n_dev-1 — so the
+per-row DCN bytes drop by ~2*sqrt(D)/D while the pair set stays
+bit-identical. This stage prices exactly that on synthetic sorted
+uint64 sketch matrices at N in {1k, 5k, 20k}:
+
+  * candidate pairs/s for the 1-D and the 2D (squarest) mesh through
+    ``sharded_threshold_pairs`` (XLA tiles — the CPU-sim twin of the
+    production pass), 2D run FIRST so its compiles land inside its
+    own timing;
+  * the modeled ``mesh.dcn_bytes_per_row`` gauge for both meshes and
+    their ratio (the communication-avoiding claim, acceptance bound
+    2*sqrt(D)/D);
+  * a pair-set parity bit per rung — a 2D mesh that returns a
+    different pair set zeroes the speedup field;
+  * the ``precluster.bucket_pruned_fraction`` of the cardinality-band
+    prefilter (ops/bucketing.py) on a log-uniform skewed-cardinality
+    corpus at the same N.
+
+Self-budgeting like the variant matrices: rungs are priced largest-
+last and skipped (recorded in `skipped`) when the remaining budget
+cannot cover them; a partial run still prints ALLPAIRS_JSON with what
+it measured.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_T0 = time.monotonic()
+
+_K = 512          # sketch width: smallest with a finite band width at
+                  # min_ani=0.95 (K=128's 6-sigma MinHash margin
+                  # swallows the threshold -> zero pruning), still
+                  # tractable at the 20k rung on CPU sim
+_MIN_ANI = 0.95
+_KMER = 21
+
+# (n, rough CPU-sim cost in seconds for both mesh passes + bucketing;
+# ~18k candidate pairs/s at K=512 on the 8-device CPU sim, so the 5k
+# and 20k rungs only run under a widened budget — TPU hardware runs
+# them orders of magnitude faster)
+_RUNGS = ((1_000, 120), (5_000, 2_000), (20_000, 24_000))
+
+
+def _left(budget):
+    return budget - (time.monotonic() - _T0)
+
+
+def _corpus(n, rng):
+    import numpy as np
+
+    mat = np.sort(rng.integers(0, 1 << 62, size=(n, _K),
+                               dtype=np.uint64), axis=1)
+    # planted near-duplicates so the pair set is non-empty at any N
+    for i in range(8):
+        a, b = i, n - 1 - i
+        mat[b] = mat[a].copy()
+        mat[b, :8] = rng.integers(0, 1 << 62, size=8, dtype=np.uint64)
+        mat[b] = np.sort(mat[b])
+    cards = np.exp(rng.uniform(np.log(1e3), np.log(1e8), size=n))
+    for i in range(8):
+        cards[n - 1 - i] = cards[i] * 1.1
+    return mat, cards
+
+
+def _run_rung(n, out):
+    import numpy as np
+
+    from galah_tpu.obs import metrics as obs_metrics
+    from galah_tpu.ops.bucketing import bucketed_threshold_pairs
+    from galah_tpu.parallel.mesh import (_squarest_factorization,
+                                         make_mesh, make_mesh_2d,
+                                         sharded_threshold_pairs)
+
+    rng = np.random.default_rng(17)
+    mat, cards = _corpus(n, rng)
+    n_dev = len(__import__("jax").devices())
+    shape = _squarest_factorization(n_dev)
+    rung = {"n": n, "n_devices": n_dev,
+            "mesh_shape": f"{shape[0]}x{shape[1]}"}
+    candidates = n * (n - 1) / 2.0
+    pair_sets = {}
+
+    # 2D first: its compiles are billed to it (conservative speedup).
+    for label, mesh in (("2d", make_mesh_2d(shape)),
+                        ("1d", make_mesh(n_dev))):
+        t0 = time.perf_counter()
+        pairs = sharded_threshold_pairs(mat, _KMER, _MIN_ANI, mesh,
+                                        use_pallas=False)
+        dt = time.perf_counter() - t0
+        pair_sets[label] = pairs
+        rung[f"{label}_pairs_per_sec"] = round(candidates / dt, 1)
+        rung[f"{label}_seconds"] = round(dt, 3)
+        rung[f"{label}_dcn_bytes_per_row"] = obs_metrics.snapshot()[
+            "mesh.dcn_bytes_per_row"]["value"]
+
+    rung["n_pairs"] = len(pair_sets["1d"])
+    rung["parity"] = pair_sets["2d"] == pair_sets["1d"]
+    rung["dcn_ratio"] = round(rung["2d_dcn_bytes_per_row"]
+                              / rung["1d_dcn_bytes_per_row"], 4)
+    if rung["parity"]:
+        rung["speedup_2d"] = round(rung["2d_pairs_per_sec"]
+                                   / rung["1d_pairs_per_sec"], 2)
+    else:
+        rung["speedup_2d"] = 0.0
+
+    bucketed = bucketed_threshold_pairs(mat, cards, k=_KMER,
+                                        min_ani=_MIN_ANI,
+                                        sketch_size=_K)
+    snap = obs_metrics.snapshot()
+    rung["bucket_pruned_fraction"] = round(
+        snap["precluster.bucket_pruned_fraction"]["value"], 4)
+    rung["bucket_count"] = snap["precluster.bucket_count"]["value"]
+    rung["bucket_parity"] = bucketed == pair_sets["1d"]
+    out["rungs"].append(rung)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=None,
+                    help="seconds for the whole stage (default 570, "
+                         "capped by GALAH_BENCH_STAGE_CAP)")
+    args = ap.parse_args()
+
+    budget = args.budget if args.budget is not None else 570.0
+    cap = os.environ.get("GALAH_BENCH_STAGE_CAP")
+    if cap:
+        budget = min(budget, float(cap))
+
+    out = {
+        "workload": f"synthetic sorted uint64 sketches, K={_K}, "
+                    f"k={_KMER}, min_ani={_MIN_ANI}, 8 planted "
+                    "near-duplicate pairs, log-uniform 1e3..1e8 "
+                    "cardinalities",
+        "rungs": [],
+        "skipped": [],
+    }
+    for n, cost in _RUNGS:
+        if _left(budget) < cost:
+            out["skipped"].append(n)
+            continue
+        try:
+            _run_rung(n, out)
+        except Exception as e:  # noqa: BLE001 - partial JSON > crash
+            out[f"n{n}_error"] = f"{type(e).__name__}: {e}"
+
+    print("ALLPAIRS_JSON " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

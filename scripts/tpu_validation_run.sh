@@ -170,6 +170,14 @@ run_stage engine_rounds 900 python -u scripts/bench_engine_rounds.py \
 # and lands in its own artifact).
 run_stage e2e_overlap 900 python -u scripts/bench_overlap.py \
   --budget 840
+# 1-D vs 2D tiled mesh all-pairs scaling (N in {1k, 5k, 20k}):
+# candidate pairs/s for both geometries, the modeled per-row DCN
+# bytes and their ratio (the communication-avoiding claim), and the
+# HLL cardinality-band prefilter's pruned fraction — pair-set parity
+# gated per rung. On real TPU hardware the bigger rungs fit the
+# budget; the CPU-sim fallback self-downshifts to the 1k rung.
+run_stage allpairs_scale 900 python -u scripts/bench_allpairs_scale.py \
+  --budget 840
 # Critical-path attribution over the bench stage's run report: which
 # stage owns the e2e wall, as per-stage blame shares (jax-free file
 # math). Soft-warn: bench_overlap prints its own OVERLAP_JSON flow

@@ -1,0 +1,272 @@
+"""2D-tiled all-pairs mesh (GALAH_TPU_MESH_SHAPE) and the HLL
+cardinality-bucketed precluster (GALAH_TPU_HLL_BUCKETS).
+
+The contract under test is bit-identity: the 2D tiled pair pass must
+return exactly the host / 1-D pair set for every mesh geometry, the
+upper-triangle tile schedule must cover each i<j cell exactly once,
+and the cardinality-band prefilter must never prune a pair the full
+pass would emit — including pairs planted exactly at the threshold
+with adversarial cardinality skew."""
+
+import numpy as np
+import pytest
+
+from galah_tpu.obs import events as obs_events
+from galah_tpu.obs import metrics as obs_metrics
+from galah_tpu.ops.bucketing import (assign_bands, band_width,
+                                     bucketed_threshold_pairs,
+                                     bucketing_engaged)
+from galah_tpu.ops.pairwise import threshold_pairs
+from galah_tpu.parallel.mesh import (_dcn_crossings, auto_mesh,
+                                     make_mesh, make_mesh_2d,
+                                     mesh_is_2d, resolve_mesh_shape,
+                                     sharded_hll_threshold_pairs,
+                                     sharded_screen_pairs,
+                                     sharded_stripe_stats,
+                                     sharded_threshold_pairs)
+
+
+def _sketches(n, k, seed=0, planted=((4, 10), (4, 33), (5, 77))):
+    rng = np.random.default_rng(seed)
+    mat = rng.integers(0, 1 << 62, size=(n, k), dtype=np.uint64)
+    for src, dst in planted:
+        mat[dst] = mat[src]
+    mat.sort(axis=1)
+    return mat
+
+
+# -- mesh shape resolution -------------------------------------------
+
+
+def test_resolve_auto_squarest(monkeypatch):
+    monkeypatch.setenv("GALAH_TPU_MESH_SHAPE", "auto")
+    assert resolve_mesh_shape(8) == (2, 4)
+    assert resolve_mesh_shape(16) == (4, 4)
+    assert resolve_mesh_shape(12) == (3, 4)
+    assert resolve_mesh_shape(1) is None
+
+
+def test_resolve_explicit_and_1d(monkeypatch):
+    monkeypatch.setenv("GALAH_TPU_MESH_SHAPE", "4x2")
+    assert resolve_mesh_shape(8) == (4, 2)
+    monkeypatch.setenv("GALAH_TPU_MESH_SHAPE", "1d")
+    assert resolve_mesh_shape(8) is None
+
+
+def test_resolve_prime_demotes_with_event(monkeypatch):
+    monkeypatch.setenv("GALAH_TPU_MESH_SHAPE", "auto")
+    obs_events.reset()
+    assert resolve_mesh_shape(7) is None
+    demoted = [e for e in obs_events.snapshot()
+               if e["kind"] == "mesh-demoted"]
+    assert len(demoted) == 1 and demoted[0]["n_devices"] == 7
+
+
+def test_resolve_bad_shape_demotes_with_event(monkeypatch):
+    obs_events.reset()
+    for raw in ("3x3", "banana", "0x8"):
+        monkeypatch.setenv("GALAH_TPU_MESH_SHAPE", raw)
+        assert resolve_mesh_shape(8) is None
+    demoted = [e for e in obs_events.snapshot()
+               if e["kind"] == "mesh-demoted"]
+    assert [e["shape"] for e in demoted] == ["3x3", "banana", "0x8"]
+
+
+def test_auto_mesh_is_2d_on_8_devices(monkeypatch):
+    monkeypatch.setenv("GALAH_TPU_MESH_SHAPE", "auto")
+    mesh = auto_mesh()
+    assert mesh_is_2d(mesh) and mesh.devices.shape == (2, 4)
+    monkeypatch.setenv("GALAH_TPU_MESH_SHAPE", "1d")
+    assert not mesh_is_2d(auto_mesh())
+
+
+# -- upper-triangle tile schedule audit ------------------------------
+
+
+@pytest.mark.parametrize("r,c,n", [(2, 4, 100), (4, 2, 64), (1, 8, 50),
+                                   (2, 2, 37)])
+def test_tile_schedule_covers_upper_triangle_exactly_once(r, c, n):
+    """Replay the 2D schedule's skip rule (tile computed iff its global
+    column tile gt >= t_first, the diagonal tile of the row block) and
+    check every i<j lattice cell lands in exactly one computed tile."""
+    import math
+
+    row_tile, col_tile = 16, 32
+    quantum = math.lcm(r * row_tile, c * col_tile)
+    n_pad = -(-n // quantum) * quantum
+    rows_per_dev, cols_per_dev = n_pad // r, n_pad // c
+    tiles_per_chunk = cols_per_dev // col_tile
+    cover = np.zeros((n, n), dtype=np.int64)
+    for mi in range(r):
+        for lb in range(0, min(rows_per_dev, n), row_tile):
+            r0 = mi * rows_per_dev + lb
+            t_first = r0 // col_tile
+            for mj in range(c):
+                col0 = mj * cols_per_dev
+                for t in range(tiles_per_chunk):
+                    gt = col0 // col_tile + t
+                    if gt < t_first:
+                        continue  # the skipped lower-triangle tile
+                    c0 = gt * col_tile
+                    for gi in range(r0, min(r0 + row_tile, n)):
+                        for gj in range(max(c0, gi + 1),
+                                        min(c0 + col_tile, n)):
+                            cover[gi, gj] += 1
+    iu = np.triu_indices(n, k=1)
+    assert cover[iu].min() == 1 and cover[iu].max() == 1
+
+
+# -- 2D pair-pass parity ---------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (1, 8), (2, 4), (4, 2)])
+def test_threshold_pairs_2d_matches_host_and_1d(shape):
+    mat = _sketches(100, 64, seed=1)
+    host = threshold_pairs(mat, k=21, min_ani=0.9)
+    ref = sharded_threshold_pairs(mat, 21, 0.9, make_mesh(8),
+                                  row_tile=16, col_tile=32,
+                                  use_pallas=False)
+    got = sharded_threshold_pairs(mat, 21, 0.9, make_mesh_2d(shape),
+                                  row_tile=16, col_tile=32,
+                                  use_pallas=False)
+    assert host == ref == got
+    assert {(4, 10), (4, 33), (10, 33), (5, 77)} <= set(got)
+
+
+def test_stripe_stats_2d_matches_1d():
+    from galah_tpu.ops.constants import SENTINEL
+
+    rng = np.random.default_rng(5)
+    rows = np.sort(rng.integers(0, 1 << 62, size=(96, 64),
+                                dtype=np.uint64), axis=1)
+    cols = np.concatenate([rows[:16], np.full((16, 64), SENTINEL,
+                                              dtype=np.uint64)])
+    ref_c, ref_t = sharded_stripe_stats(rows, cols, 64, 21,
+                                        make_mesh(8), row_tile=16,
+                                        r_pad=128)
+    got_c, got_t = sharded_stripe_stats(rows, cols, 64, 21,
+                                        make_mesh_2d((2, 4)),
+                                        row_tile=16, r_pad=128)
+    np.testing.assert_array_equal(np.asarray(ref_c), np.asarray(got_c))
+    np.testing.assert_array_equal(np.asarray(ref_t), np.asarray(got_t))
+
+
+def test_screen_pairs_2d_matches_1d():
+    rng = np.random.default_rng(6)
+    marker = rng.random((60, 256)) < 0.05
+    marker[7] = marker[3]
+    counts = marker.sum(axis=1).astype(np.int32)
+    ref = sharded_screen_pairs(marker, counts, 0.6, make_mesh(8),
+                               row_tile=16, col_tile=32,
+                               cap_per_row=64, use_pallas=False)
+    got = sharded_screen_pairs(marker, counts, 0.6, make_mesh_2d((2, 4)),
+                               row_tile=16, col_tile=32,
+                               cap_per_row=64, use_pallas=False)
+    assert sorted(ref) == sorted(got) and (3, 7) in got
+
+
+def test_hll_threshold_pairs_2d_matches_1d():
+    rng = np.random.default_rng(7)
+    regs = rng.integers(0, 30, size=(64, 4096), dtype=np.uint8)
+    regs[11] = regs[2]
+    ref = sharded_hll_threshold_pairs(regs, 21, 0.95, make_mesh(8),
+                                      row_tile=16, col_tile=32,
+                                      cap_per_row=32)
+    got = sharded_hll_threshold_pairs(regs, 21, 0.95,
+                                      make_mesh_2d((2, 4)),
+                                      row_tile=16, col_tile=32,
+                                      cap_per_row=32)
+    assert ref == got and (2, 11) in got
+
+
+def test_dcn_gauge_2d_below_sqrt_bound():
+    """Acceptance bound: per-row interconnect bytes on the 2x4 mesh
+    must be <= 2*sqrt(8)/8 of the 1-D mesh's."""
+    mat = _sketches(64, 64, seed=8, planted=((4, 10),))
+    sharded_threshold_pairs(mat, 21, 0.9, make_mesh(8), row_tile=16,
+                            col_tile=32, use_pallas=False)
+    one_d = obs_metrics.snapshot()["mesh.dcn_bytes_per_row"]["value"]
+    sharded_threshold_pairs(mat, 21, 0.9, make_mesh_2d((2, 4)),
+                            row_tile=16, col_tile=32, use_pallas=False)
+    two_d = obs_metrics.snapshot()["mesh.dcn_bytes_per_row"]["value"]
+    assert two_d / one_d <= 2.0 * np.sqrt(8.0) / 8.0
+    assert _dcn_crossings(make_mesh_2d((2, 4))) == 4
+    assert _dcn_crossings(make_mesh(8)) == 7
+
+
+# -- HLL cardinality bucketing ---------------------------------------
+
+
+def _skewed_corpus(n=240, size=1024, seed=9, n_planted=4):
+    """Random sketches with log-uniform cardinalities 1e3..1e8 and
+    planted near-duplicate pairs whose cardinalities sit at the band
+    boundary (worst-case skew the filter must tolerate)."""
+    rng = np.random.default_rng(seed)
+    mat = np.sort(rng.integers(0, 1 << 62, size=(n, size),
+                               dtype=np.uint64), axis=1)
+    cards = np.exp(rng.uniform(np.log(1e3), np.log(1e8), size=n))
+    planted = []
+    for i in range(n_planted):
+        a, b = 2 * i, n - 1 - 2 * i
+        mat[b] = mat[a].copy()
+        mat[b, :40] = rng.integers(0, 1 << 62, size=40,
+                                   dtype=np.uint64)
+        mat[b] = np.sort(mat[b])
+        # adversarial skew: put the twin right at the admissible edge
+        cards[b] = cards[a] * 1.2
+        planted.append((min(a, b), max(a, b)))
+    return mat, cards, planted
+
+
+def test_bucketed_pairs_bit_identical_with_pruning():
+    mat, cards, planted = _skewed_corpus()
+    ref = threshold_pairs(mat, k=21, min_ani=0.95)
+    got = bucketed_threshold_pairs(mat, cards, k=21, min_ani=0.95)
+    assert got == ref
+    assert set(planted) <= set(got)
+    snap = obs_metrics.snapshot()
+    assert snap["precluster.bucket_count"]["value"] > 1
+    # acceptance: >= 30% of the lattice pruned on the skewed corpus
+    assert snap["precluster.bucket_pruned_fraction"]["value"] >= 0.30
+    evs = [e for e in obs_events.snapshot()
+           if e["kind"] == "hll-buckets"]
+    assert evs and evs[-1]["pruned"] > 0
+
+
+def test_boundary_pairs_never_pruned_across_band_offsets():
+    """Pairs planted at every band-boundary offset (cardinality ratios
+    sweeping the full admissible range) must always land within one
+    band of each other."""
+    width = band_width(0.95, 21, 12, 1024)
+    assert np.isfinite(width)
+    base = 1e5
+    for frac in (0.999, 0.5, 0.01):
+        ratio = np.exp(width * frac)
+        cards = np.array([base, base * ratio])
+        bands = assign_bands(cards, 0.95, 21, 12, 1024)
+        assert abs(int(bands[1]) - int(bands[0])) <= 1, frac
+
+
+def test_degenerate_margin_single_band_still_exact():
+    """Tiny sketches: the MinHash margin swallows the threshold, the
+    band width goes infinite, everything lands in band 0 — zero
+    pruning, still the exact pair set."""
+    assert band_width(0.9, 21, 12, 128) == np.inf
+    mat = _sketches(80, 128, seed=11)
+    cards = np.exp(np.random.default_rng(11).uniform(
+        np.log(1e3), np.log(1e8), size=80))
+    bands = assign_bands(cards, 0.9, 21, 12, 128)
+    assert np.all(bands == 0)
+    ref = threshold_pairs(mat, k=21, min_ani=0.9)
+    assert bucketed_threshold_pairs(mat, cards, k=21, min_ani=0.9) \
+        == ref
+
+
+def test_bucketing_engaged_flag(monkeypatch):
+    monkeypatch.setenv("GALAH_TPU_HLL_BUCKETS", "0")
+    assert not bucketing_engaged(10 ** 9)
+    monkeypatch.setenv("GALAH_TPU_HLL_BUCKETS", "1")
+    assert bucketing_engaged(2) and not bucketing_engaged(1)
+    monkeypatch.setenv("GALAH_TPU_HLL_BUCKETS", "auto")
+    monkeypatch.setenv("GALAH_TPU_SPARSE_MIN_N", "100")
+    assert bucketing_engaged(100) and not bucketing_engaged(99)

@@ -219,8 +219,9 @@ def test_entry_from_report_key_and_metrics():
     key = entry["key"]
     assert key["backend"] == "cpu" and key["n_devices"] == 1
     assert key["workload"] == {"n": 256, "k": 1000, "p": 8}
-    # pairlist / fragment / greedy / sketch / overlap pins, in order
-    assert key["strategy"] == "auto/auto/device/auto/auto"
+    # pairlist / fragment / greedy / sketch / overlap / mesh-shape
+    # pins, in order
+    assert key["strategy"] == "auto/auto/device/auto/auto/auto"
     assert key["source"] == "cluster"
     m = entry["metrics"]
     assert m["run.duration_s"] == 12.0
