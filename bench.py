@@ -770,6 +770,96 @@ def _run_index_stage(stages, errors):
         errors.append(f"index_service: {type(e).__name__}: {e}")
 
 
+def _run_fleet_stage(stages, errors):
+    """Elastic-fleet supervisor scaling (galah_tpu/fleet/): the same
+    planted-family corpus through `galah-tpu fleet run` — 3 shards
+    across 2 preemptible worker subprocesses plus the cross-shard
+    merge — vs ONE single-process `cluster` run. Emits fleet
+    genomes/s, the fleet/single wall ratio (worker-interpreter spinup
+    + supervision + merge overhead), and the merge wall clock, and
+    asserts the byte-identity contract on the way: a throughput
+    number for a divergent answer is not a number."""
+    _FLEET_COST = 480
+    if not _admit(_FLEET_COST, "fleet_scale", errors):
+        return
+    import shutil
+    import tempfile
+
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        work = tempfile.mkdtemp(prefix="galah_fleetbench_")
+        try:
+            gdir = os.path.join(work, "genomes")
+            os.makedirs(gdir, exist_ok=True)
+            paths = _synth_families(n_genomes=24, genome_len=40_000,
+                                    n_families=6, mut=0.03, seed=13,
+                                    outdir=gdir)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            # shared profile cache: shard profiling warms what the
+            # merge's cross-shard pass reuses, like a real deployment
+            env["GALAH_TPU_CACHE"] = os.path.join(work, "cache")
+            base = [sys.executable, "-m", "galah_tpu.cli"]
+            common = ["--genome-fasta-files", *paths,
+                      "--precluster-method", "skani",
+                      "--cluster-method", "skani"]
+            single_tsv = os.path.join(work, "single.tsv")
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                base + ["cluster", "--platform", "cpu", *common,
+                        "--output-cluster-definition", single_tsv],
+                capture_output=True, text=True,
+                timeout=_FLEET_COST // 2, cwd=here, env=env)
+            single_s = time.perf_counter() - t0
+            if proc.returncode != 0:
+                raise RuntimeError(f"single-process run rc="
+                                   f"{proc.returncode}: "
+                                   f"{proc.stderr[-300:]}")
+            fleet_tsv = os.path.join(work, "fleet.tsv")
+            report = os.path.join(work, "fleet_report.json")
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                base + ["fleet", "--platform", "cpu", "run", *common,
+                        "--fleet-dir", os.path.join(work, "fleet"),
+                        "--workers", "2", "--shards", "3",
+                        "--output-cluster-definition", fleet_tsv,
+                        "--run-report", report],
+                capture_output=True, text=True,
+                timeout=_FLEET_COST // 2, cwd=here, env=env)
+            fleet_s = time.perf_counter() - t0
+            if proc.returncode != 0:
+                raise RuntimeError(f"fleet run rc={proc.returncode}: "
+                                   f"{proc.stderr[-300:]}")
+            with open(single_tsv, "rb") as f:
+                single_bytes = f.read()
+            with open(fleet_tsv, "rb") as f:
+                if f.read() != single_bytes:
+                    raise RuntimeError(
+                        "fleet clusters differ from the "
+                        "single-process run")
+            stages["fleet_genomes_per_sec"] = round(
+                len(paths) / fleet_s, 2)
+            stages["fleet_vs_single_wall"] = round(fleet_s / single_s,
+                                                   2)
+            with open(report) as f:
+                fl = json.load(f).get("fleet") or {}
+            if isinstance(fl.get("merge_wall_s"), (int, float)):
+                stages["fleet_merge_wall_s"] = round(
+                    fl["merge_wall_s"], 3)
+            from galah_tpu import obs
+
+            for k, hlp in (("n_shards", "Fleet bench shard count"),
+                           ("workers", "Fleet bench worker cap")):
+                if isinstance(fl.get(k), (int, float)):
+                    obs.metrics.gauge(
+                        f"workload.fleet_{k}",
+                        help=hlp).set(float(fl[k]))
+        finally:
+            shutil.rmtree(work, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"fleet_scale: {type(e).__name__}: {e}")
+
+
 def run_ladder_stages(stages, errors):
     """North-star-relevant e2e evidence in the driver artifact itself.
 
@@ -1005,6 +1095,9 @@ def main():
         # The index service is specified against CPU latency targets,
         # so the fallback branch runs the real measurement too.
         _run_index_stage(stages, errors)
+        # Fleet workers are subprocesses either way — the supervision
+        # overhead measurement is as real on the fallback branch.
+        _run_fleet_stage(stages, errors)
         _finalize_obs(result, started_at)
         print(json.dumps(result))
         return
@@ -1120,6 +1213,10 @@ def main():
     # 4g. Incremental-index service: build-once, insert-10%,
     # warm query-latency sweep (p50 target < 50 ms on CPU).
     _run_index_stage(stages, errors)
+
+    # 4h. Elastic fleet: sharded multi-worker run vs single-process,
+    # byte-identity asserted, supervision + merge overhead recorded.
+    _run_fleet_stage(stages, errors)
 
     # 5. Sketching throughput on real FASTA bytes, both hash algos —
     # each with its own watchdog so one failure never loses the other.

@@ -73,6 +73,7 @@ THREADED_MODULES = (
     "galah_tpu/ops/sketch_stream.py",
     "galah_tpu/index/store.py",
     "galah_tpu/index/incremental.py",
+    "galah_tpu/fleet/scheduler.py",
 )
 
 #: Method calls that mutate their receiver in place.

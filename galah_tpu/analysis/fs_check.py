@@ -45,6 +45,9 @@ DURABLE_MODULES = (
     "galah_tpu/resilience/quarantine.py",
     "galah_tpu/index/store.py",
     "galah_tpu/index/incremental.py",
+    "galah_tpu/fleet/plan.py",
+    "galah_tpu/fleet/scheduler.py",
+    "galah_tpu/fleet/merge.py",
 )
 
 #: The one sanctioned writer.

@@ -457,3 +457,32 @@ class SkaniPreclusterer(PreclusterBackend):
         logger.info("Found %d pairs passing precluster threshold %.4f",
                     len(cache), self.threshold)
         return cache
+
+    def distances_subset(self, genome_paths: Sequence[str],
+                         keep) -> PairDistanceCache:
+        """Single-host distances() restricted to screened pairs with
+        ``keep(i, j)`` true. The fleet merge computes only CROSS-shard
+        pairs through this: same profile, screen and exact-ANI code
+        path as the full run, only the pair list is filtered, so the
+        kept values are bit-identical to a full distances() run's
+        (the merge-determinism argument in docs/resilience.md)."""
+        n = len(genome_paths)
+        logger.info("Profiling %d genomes for cross-shard merge ..", n)
+        with timing.stage("profile-genomes"):
+            with self.store.reserve(n):
+                profiles = self.store.get_many(genome_paths)
+        mat, counts = self._marker_matrix(profiles, n)
+        c_floor = self.SCREEN_IDENTITY ** self.store.k
+        with timing.stage("marker-screen"):
+            pairs = [p for p in screen_pairs(mat, counts, c_floor)
+                     if keep(p[0], p[1])]
+        logger.info("%d cross-shard pairs passed screening; "
+                    "computing exact ANI ..", len(pairs))
+        cache = PairDistanceCache()
+        anis = _guarded_ani_values(
+            [(profiles[i], profiles[j]) for i, j in pairs],
+            self.min_aligned_fraction, self.store.threads)
+        for (i, j), ani in zip(pairs, anis):
+            if ani is not None and ani >= self.threshold:
+                cache.insert((i, j), ani)
+        return cache

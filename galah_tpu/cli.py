@@ -415,10 +415,72 @@ def build_parser() -> argparse.ArgumentParser:
         "fsck",
         help="Audit the on-disk index: commit-pointer integrity, log "
              "checksums, cluster invariants (never mutates; jax-free)")
+    ft = sub.add_parser(
+        "fleet",
+        help="Run one dereplication job across preemptible worker "
+             "subprocesses (shard, supervise, reassign, merge)",
+        description="Elastic preemptible-fleet execution: `run` shards "
+                    "the quality-ordered genome set, supervises one "
+                    "`galah-tpu cluster` worker per shard (exit 75, "
+                    "SIGKILL and stale heartbeats all mean preemption "
+                    "-> reassign; retry budget per shard), then merges "
+                    "shard checkpoints into clusters byte-identical "
+                    "to a single-process run; `status` renders a "
+                    "fleet directory's plan/event/heartbeat state "
+                    "(jax-free). See docs/resilience.md")
+    _add_verbosity(ft)
+    ftsub = ft.add_subparsers(dest="fleet_action")
+    ftr = ftsub.add_parser(
+        "run",
+        help="Shard, supervise and merge one dereplication job")
+    _add_genome_inputs(ftr)
+    add_cluster_arguments(ftr)
+    ftr.add_argument("--fleet-dir", required=True,
+                     help="Fleet working directory: shard plan, event "
+                          "log, per-shard checkpoints/reports live "
+                          "here (the resume root)")
+    ftr.add_argument("--workers", type=int,
+                     help="Max live worker subprocesses (default: "
+                          "GALAH_TPU_FLEET_WORKERS)")
+    ftr.add_argument("--shards", type=int,
+                     help="Shard count (default: GALAH_TPU_FLEET_SHARDS "
+                          "or the worker cap)")
+    ftr.add_argument("--stale-s", type=float,
+                     help="Heartbeat staleness deadline in seconds "
+                          "(default: GALAH_TPU_FLEET_STALE_S)")
+    ftr.add_argument("--resume", action="store_true",
+                     help="Require resuming the fleet at --fleet-dir: "
+                          "fail if the plan is missing or belongs to "
+                          "a different configuration. Without this "
+                          "flag a matching plan still auto-resumes")
+    ftr.add_argument("--sketch-cache",
+                     help="Shared sketch/profile cache for workers and "
+                          "the merge (default: <fleet-dir>/cache; also "
+                          "via GALAH_TPU_CACHE)")
+    ftr.add_argument("--run-report",
+                     help="Write the supervisor's run_report.json "
+                          "(with its `fleet` section) to this file. "
+                          "Env equivalent: GALAH_OBS_REPORT")
+    ftr.add_argument("--output-cluster-definition",
+                     help="Output file of rep<TAB>member lines")
+    ftr.add_argument("--output-representative-fasta-directory",
+                     help="Symlink representative genomes into this "
+                          "directory")
+    ftr.add_argument("--output-representative-fasta-directory-copy",
+                     help="Copy representative genomes into this "
+                          "directory")
+    ftr.add_argument("--output-representative-list",
+                     help="Output file with one representative path "
+                          "per line")
+    fts = ftsub.add_parser(
+        "status",
+        help="Render a fleet directory's shard/event/heartbeat state "
+             "(jax-free; usable while a fleet is live)")
+    fts.add_argument("fleet_dir", help="Fleet working directory")
     parser._subcommand_parsers = {"cluster": c, "cluster-validate": v,
                                   "dist": dd, "lint": li, "report": rp,
                                   "perf": pf, "flow": fl, "top": tp,
-                                  "index": ix}
+                                  "index": ix, "fleet": ft}
     return parser
 
 
@@ -700,6 +762,243 @@ def _run_cluster_inner(args) -> int:
                        "after persistent failures (%s)",
                        dem.site, dem.reason)
     timing.GLOBAL.report(logger)
+    return 0
+
+
+def run_fleet(args) -> int:
+    """`galah-tpu fleet run`: same telemetry lifecycle as run_cluster
+    (the supervisor writes its own run report, with a `fleet`
+    section)."""
+    import time as _time
+
+    from galah_tpu import obs
+    from galah_tpu.config import env_value
+    from galah_tpu.resilience import interrupt
+
+    # wall-clock stamp for the report header, not a duration measure
+    started_at = _time.time()  # galah-lint: ignore[GL701]
+    timing.reset()
+    obs.reset_run()
+    interrupt.reset()
+    interrupt.install()
+    trace_path = (getattr(args, "trace_events", None)
+                  or env_value("GALAH_OBS_TRACE_EVENTS"))
+    if trace_path:
+        obs.trace.start(trace_path)
+    report_path = (getattr(args, "run_report", None)
+                   or env_value("GALAH_OBS_REPORT"))
+    obs.install_crash_hooks()
+    obs.heartbeat.maybe_start(report_path)
+    try:
+        return _run_fleet_inner(args)
+    finally:
+        interrupt.uninstall()
+        obs.finalize("fleet", report_path=report_path,
+                     started_at=started_at)
+
+
+def _fleet_worker_argv(args, fleet_dir: str, cache_path: str):
+    """Worker command-line builder: one `galah-tpu cluster` run per
+    shard, genomes passed explicitly in (already quality-ordered)
+    shard order so the worker never re-orders them."""
+    from galah_tpu.fleet import scheduler as fleet_scheduler
+
+    def worker_argv(spec, resume: bool):
+        sid = spec.shard_id
+        argv = [sys.executable, "-m", "galah_tpu.cli", "cluster",
+                "--genome-fasta-files", *spec.genomes,
+                "--ani", str(args.ani),
+                "--precluster-ani", str(args.precluster_ani),
+                "--min-aligned-fraction",
+                str(args.min_aligned_fraction),
+                "--fragment-length", str(args.fragment_length),
+                "--precluster-method", args.precluster_method,
+                "--cluster-method", args.cluster_method,
+                "--ani-subsample", str(args.ani_subsample),
+                "--hash-algorithm", args.hash_algorithm,
+                "--threads", str(getattr(args, "threads", 1) or 1),
+                "--checkpoint-dir",
+                fleet_scheduler.shard_ckpt_dir(fleet_dir, sid),
+                "--run-report",
+                fleet_scheduler.shard_report_path(fleet_dir, sid),
+                "--output-cluster-definition",
+                fleet_scheduler.shard_tsv_path(fleet_dir, sid)]
+        if cache_path:
+            argv += ["--sketch-cache", cache_path]
+        if resume:
+            argv.append("--resume")
+        return argv
+
+    return worker_argv
+
+
+def _run_fleet_inner(args) -> int:
+    import time as _time
+
+    from galah_tpu import fleet as fleet_pkg
+    from galah_tpu.cluster.checkpoint import fingerprint_fields
+    from galah_tpu.config import env_value
+    from galah_tpu.fleet import merge as fleet_merge
+    from galah_tpu.fleet import plan as fleet_plan
+    from galah_tpu.fleet.scheduler import FleetScheduler
+    from galah_tpu.genome_inputs import parse_genome_inputs
+    from galah_tpu.io import atomic, diskcache
+    from galah_tpu.obs import events
+    from galah_tpu.outputs import setup_outputs, write_outputs
+    from galah_tpu.resilience import interrupt
+    from galah_tpu.resilience.quarantine import QuarantineManifest
+
+    # v1 gate: the merge-determinism argument needs shard checkpoints
+    # thresholded at the FINAL ANI, which is exactly the skani/skani
+    # configuration (api.py pins precluster_ani = ani there). Other
+    # method combinations shard correctly but merge approximately —
+    # refuse rather than silently weaken the byte-identical contract.
+    if (args.precluster_method != "skani"
+            or args.cluster_method != "skani"):
+        logger.error(
+            "fleet run requires --precluster-method skani and "
+            "--cluster-method skani (got %s/%s): the cross-shard "
+            "merge is only byte-identical when shard checkpoints are "
+            "thresholded at the final ANI", args.precluster_method,
+            args.cluster_method)
+        return 1
+
+    fleet_dir = args.fleet_dir
+    on_bad_genome = getattr(args, "on_bad_genome", "error") or "error"
+    qmanifest = QuarantineManifest()
+    genomes = parse_genome_inputs(
+        genome_fasta_files=args.genome_fasta_files,
+        genome_fasta_list=args.genome_fasta_list,
+        genome_fasta_directory=args.genome_fasta_directory,
+        genome_fasta_extension=args.genome_fasta_extension,
+        on_bad_genome=on_bad_genome,
+        manifest=qmanifest,
+    )
+
+    # One shared profile cache across workers and the merge: shard
+    # profiling warms it, the merge's cross-shard pass reuses it.
+    cache_path = (getattr(args, "sketch_cache", None)
+                  or diskcache.default_cache_dir()
+                  or os.path.join(fleet_dir, "cache"))
+    cache = diskcache.get_cache(cache_path)
+    logger.info("Using shared fleet sketch cache at %s", cache.path)
+
+    try:
+        clusterer = generate_galah_clusterer(
+            genomes, vars(args), cache=cache,
+            quarantine_manifest=qmanifest)
+    except ValueError as e:
+        logger.error("%s", e)
+        return 1
+    genomes = clusterer.genome_paths
+    ani = parse_percentage(args.ani, "--ani")
+
+    workers = (args.workers
+               or int(env_value("GALAH_TPU_FLEET_WORKERS") or 2))
+    n_shards = (args.shards
+                or int(env_value("GALAH_TPU_FLEET_SHARDS") or 0)
+                or workers)
+    stale_s = (args.stale_s if args.stale_s is not None
+               else float(env_value("GALAH_TPU_FLEET_STALE_S") or 30))
+    poll_s = float(env_value("GALAH_TPU_FLEET_POLL_S") or 0.2)
+    heartbeat_s = float(
+        env_value("GALAH_TPU_FLEET_HEARTBEAT_S") or 1)
+
+    fields = fingerprint_fields(
+        genomes, args.precluster_method, args.cluster_method, ani,
+        parse_percentage(args.precluster_ani, "--precluster-ani"),
+        min_aligned_fraction=parse_percentage(
+            args.min_aligned_fraction, "--min-aligned-fraction"),
+        fragment_length=args.fragment_length,
+        backend_params=clusterer.backend_params)
+    try:
+        shards = fleet_plan.ensure_plan(
+            fleet_dir, genomes, fields, n_shards,
+            require_match=getattr(args, "resume", False))
+    except ValueError as e:
+        logger.error("%s", e)
+        return 1
+    logger.info("Fleet plan: %d genomes in %d shard(s), %d worker(s)",
+                len(genomes), len(shards), workers)
+
+    # Open output handles before compute (fail fast), like cluster.
+    handles = setup_outputs(
+        cluster_definition=args.output_cluster_definition,
+        representative_fasta_directory=(
+            args.output_representative_fasta_directory),
+        representative_fasta_directory_copy=(
+            args.output_representative_fasta_directory_copy),
+        representative_list=args.output_representative_list,
+    )
+
+    # Resume chain: prior fleet-interrupted events mean this run
+    # continues a preempted supervisor.
+    prior_records, _torn = atomic.read_jsonl(
+        fleet_plan.events_path(fleet_dir))
+    prior = [r for r in prior_records if isinstance(r, dict)
+             and r.get("ev") == "fleet-interrupted"]
+    if prior or getattr(args, "resume", False):
+        if prior_records:
+            interrupt.note_resume(fleet_dir, len(prior))
+            events.record("resumed", fleet_dir=fleet_dir,
+                          prior_interruptions=len(prior))
+
+    sched = FleetScheduler(
+        fleet_dir, shards,
+        _fleet_worker_argv(args, fleet_dir, cache.path or cache_path),
+        workers=workers, stale_s=stale_s, poll_s=poll_s,
+        heartbeat_s=heartbeat_s)
+    try:
+        with timing.stage("fleet-supervise"):
+            snap = sched.run()
+    except interrupt.PreemptionRequested as e:
+        events.record("preempted", signal=e.signame,
+                      boundary=e.boundary)
+        fleet_pkg.set_snapshot(sched.snapshot())
+        logger.warning(
+            "Fleet preempted (%s) at %r: worker checkpoints are "
+            "consistent; rerun with --resume to continue. Exiting %d.",
+            e.signame, e.boundary, interrupt.EXIT_PREEMPTED)
+        return interrupt.EXIT_PREEMPTED
+
+    if snap["shards_failed"]:
+        fleet_pkg.set_snapshot(snap)
+        logger.error(
+            "%d shard(s) exhausted their retry budget (see "
+            "fleet-shard-failed events at %s); not merging a partial "
+            "fleet", snap["shards_failed"],
+            fleet_plan.events_path(fleet_dir))
+        return 1
+
+    merge_t0 = _time.monotonic()
+    with timing.stage("fleet-merge"):
+        clusters = fleet_merge.merge(fleet_dir, genomes, shards,
+                                     clusterer.preclusterer, ani)
+    snap["merge_wall_s"] = round(_time.monotonic() - merge_t0, 6)
+    snap["n_genomes"] = len(genomes)
+    fleet_pkg.set_snapshot(snap)
+    logger.info("Found %d genome clusters", len(clusters))
+
+    with timing.stage("write-outputs"):
+        write_outputs(handles, clusters, genomes)
+    logger.info("Finished printing genome clusters")
+
+    if clusterer.quarantine is not None and len(clusterer.quarantine):
+        from galah_tpu.resilience.quarantine import manifest_output_dir
+
+        clusterer.quarantine.write(manifest_output_dir(
+            cluster_definition=args.output_cluster_definition,
+            representative_list=args.output_representative_list,
+            checkpoint_dir=fleet_dir))
+    timing.GLOBAL.report(logger)
+    return 0
+
+
+def run_fleet_status(args) -> int:
+    """`galah-tpu fleet status`: jax-free rendering of a fleet dir."""
+    from galah_tpu.fleet.scheduler import render_status
+
+    sys.stdout.write(render_status(args.fleet_dir))
     return 0
 
 
@@ -1148,6 +1447,14 @@ def main(argv=None) -> int:
     if args.subcommand == "top":
         # Tails heartbeat.jsonl — jax-free, usable while a run is live.
         return run_top_cmd(args)
+    if args.subcommand == "fleet" and \
+            getattr(args, "fleet_action", None) != "run":
+        # `fleet status` reads plan/events/heartbeats — jax-free, so it
+        # works beside a live fleet on accelerator-less hosts too.
+        if getattr(args, "fleet_action", None) == "status":
+            return run_fleet_status(args)
+        parser._subcommand_parsers["fleet"].print_help()
+        return 1
     platform = (getattr(args, "platform", None)
                 or os.environ.get("GALAH_TPU_PLATFORM"))
     if platform:
@@ -1184,6 +1491,8 @@ def main(argv=None) -> int:
             return run_dist(args)
         elif args.subcommand == "index":
             return run_index(args)
+        elif args.subcommand == "fleet":
+            return run_fleet(args)
         else:
             return run_cluster_validate(args)
     except (ValueError, OSError, KeyError) as e:

@@ -298,11 +298,40 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "'kill' fires at any site and os._exit()s the process "
               "mid-operation (the chaos harness primitive, "
               "scripts/chaos_run.py). See docs/resilience.md"),
+    Flag("GALAH_TPU_FLEET_WORKERS", kind="int", default="2",
+         section="resilience",
+         help="Fleet supervisor (galah-tpu fleet run): maximum worker "
+              "subprocesses live at once. Shards queue behind the "
+              "worker cap and are reassigned on preemption "
+              "(docs/resilience.md, Fleet execution)"),
+    Flag("GALAH_TPU_FLEET_SHARDS", kind="int", section="resilience",
+         help="Fleet shard count: contiguous quality-order slices of "
+              "the genome set, one worker run each. Unset defaults to "
+              "the worker cap"),
+    Flag("GALAH_TPU_FLEET_STALE_S", kind="float", default="30",
+         section="resilience",
+         help="Heartbeat staleness deadline, seconds: a worker whose "
+              "newest heartbeat record is older than this is killed "
+              "and its shard reassigned (same treatment as exit 75 "
+              "and SIGKILL). Requires a nonzero fleet heartbeat "
+              "period"),
+    Flag("GALAH_TPU_FLEET_POLL_S", kind="float", default="0.2",
+         section="resilience",
+         help="Fleet supervisor poll period, seconds"),
+    Flag("GALAH_TPU_FLEET_HEARTBEAT_S", kind="float", default="1",
+         section="resilience",
+         help="GALAH_OBS_HEARTBEAT_S value injected into fleet "
+              "workers (their liveness signal); 0 disables worker "
+              "heartbeats AND staleness detection"),
 ) + _retry_family(
     "GALAH_RETRY", "Device-dispatch retry policy"
 ) + _retry_family(
     "GALAH_IO_RETRY", "FASTA/IO retry policy (defaults: 3 attempts, "
     "0.1 s base delay)"
+) + _retry_family(
+    "GALAH_TPU_FLEET_RETRY", "Per-shard fleet reassignment budget "
+    "(max_attempts bounds worker-fault preemptions per shard before "
+    "quarantine; delays pace the relaunch backoff)"
 ) + (
     # -- bench / test / scripts -------------------------------------------
     Flag("GALAH_BENCH_STAGE_CAP", kind="float", default="3000",
@@ -336,7 +365,8 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
 FLAGS: Dict[str, Flag] = {f.name: f for f in _FLAG_DEFS}
 
 #: Dynamic-prefix families (read via f-strings, e.g. RetryPolicy.from_env).
-FLAG_FAMILIES: Tuple[str, ...] = ("GALAH_RETRY", "GALAH_IO_RETRY")
+FLAG_FAMILIES: Tuple[str, ...] = ("GALAH_RETRY", "GALAH_IO_RETRY",
+                                  "GALAH_TPU_FLEET_RETRY")
 
 
 def env_value(name: str) -> Optional[str]:

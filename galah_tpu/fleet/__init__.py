@@ -1,0 +1,34 @@
+"""Elastic preemptible-fleet execution: shard, supervise, merge.
+
+``plan`` slices the quality-ordered genome set into self-describing
+shard specs; ``scheduler`` supervises one ``galah-tpu cluster`` worker
+subprocess per shard (preemption-aware, bounded retries); ``merge``
+recombines shard checkpoints into clusters bit-identical to a
+single-process run. See docs/resilience.md "Fleet execution".
+
+This package module stays stdlib-only at import: the run-report
+assembler reads the snapshot below on hosts with no accelerator, and
+must never drag jax (or even numpy) in through it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+#: Last fleet run's summary, mirrored into the run report's
+#: ``fleet`` section by obs/report.assemble (reset with reset_run).
+_SNAPSHOT: Optional[Dict[str, Any]] = None
+
+
+def set_snapshot(snap: Dict[str, Any]) -> None:
+    global _SNAPSHOT
+    _SNAPSHOT = dict(snap)
+
+
+def snapshot() -> Optional[Dict[str, Any]]:
+    return dict(_SNAPSHOT) if _SNAPSHOT is not None else None
+
+
+def reset() -> None:
+    global _SNAPSHOT
+    _SNAPSHOT = None

@@ -1,0 +1,106 @@
+"""Deterministic cross-shard merge: shard caches + cross pairs + replay.
+
+Why this is bit-identical to a single-process run (the exact-replay
+argument index/incremental.py already makes, extended across shards):
+
+  * the skani pair pipeline is subset-invariant — a pair's exact ANI
+    depends only on the two genomes' fragment profiles, and the marker
+    screen is a per-pair predicate, so a shard-local distances() run
+    produces the SAME values for its intra-shard pairs as the full run
+    would (and the v1 skani/skani gate in the CLI pins the shard
+    threshold to the final ANI, so shard caches hold exactly the
+    full-run cache restricted to intra-shard pairs);
+  * the remaining cross-shard pairs are computed here through the same
+    profile → screen → exact-ANI path, filtered to cross pairs only
+    (SkaniPreclusterer.distances_subset);
+  * the union, remapped to global quality-order indices by each
+    shard's ``lo`` offset, IS the full-run pair cache, and replaying
+    the greedy engine over it (index/incremental.screen_new_genomes +
+    clusters_from_state) reproduces cluster/engine.py's decisions
+    byte-for-byte.
+
+A rep-only hierarchical merge is NOT used: a shard-local rep that
+globally joins an earlier rep can locally absorb a genome that
+globally becomes its own rep, so only the full-pair replay is safe.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from galah_tpu.fleet import scheduler as fleet_scheduler
+from galah_tpu.fleet.plan import ShardSpec
+
+logger = logging.getLogger(__name__)
+
+
+def shard_lookup(shards: Sequence[ShardSpec]) -> Callable[[int], int]:
+    """Global genome index -> shard id (contiguous spans)."""
+    bounds = [(s.lo, s.hi, s.shard_id) for s in shards]
+
+    def lookup(g: int) -> int:
+        for lo, hi, sid in bounds:
+            if lo <= g < hi:
+                return sid
+        raise IndexError(f"genome index {g} outside every shard")
+
+    return lookup
+
+
+def load_shard_pairs(fleet_dir: str, shards: Sequence[ShardSpec]
+                     ) -> Dict[Tuple[int, int], float]:
+    """Union of the shard checkpoints' distance caches, remapped from
+    shard-local to global indices by each shard's ``lo`` offset."""
+    pairs: Dict[Tuple[int, int], float] = {}
+    for s in shards:
+        path = fleet_scheduler.shard_distances_path(fleet_dir,
+                                                    s.shard_id)
+        with np.load(path) as z:
+            ii, jj = z["ii"], z["jj"]
+            vals, has_val = z["vals"], z["has_val"]
+        kept = 0
+        for i, j, v, hv in zip(ii.tolist(), jj.tolist(),
+                               vals.tolist(), has_val.tolist()):
+            if not hv:
+                continue
+            pairs[(i + s.lo, j + s.lo)] = float(v)
+            kept += 1
+        logger.info("fleet merge: shard %d contributed %d pair(s)",
+                    s.shard_id, kept)
+    return pairs
+
+
+def cross_shard_pairs(genomes: Sequence[str],
+                      shards: Sequence[ShardSpec],
+                      preclusterer) -> Dict[Tuple[int, int], float]:
+    """Thresholded exact ANI for every screened pair whose endpoints
+    live in different shards (same code path as the full run)."""
+    lookup = shard_lookup(shards)
+    cache = preclusterer.distances_subset(
+        genomes, lambda i, j: lookup(i) != lookup(j))
+    return {k: cache.get(k) for k in cache.keys()
+            if cache.get(k) is not None}
+
+
+def merge(fleet_dir: str, genomes: Sequence[str],
+          shards: Sequence[ShardSpec], preclusterer,
+          ani_threshold: float) -> List[List[int]]:
+    """Merge shard checkpoints into the final cluster list (global
+    quality-order indices, cluster/engine.py output order)."""
+    from galah_tpu.index.incremental import (clusters_from_state,
+                                             screen_new_genomes)
+    from galah_tpu.index.store import IndexState
+
+    pairs = load_shard_pairs(fleet_dir, shards)
+    n_within = len(pairs)
+    pairs.update(cross_shard_pairs(genomes, shards, preclusterer))
+    logger.info("fleet merge: %d within-shard + %d cross-shard pairs",
+                n_within, len(pairs) - n_within)
+    state = IndexState(generation=0, genomes=list(genomes), keys=[],
+                       sketches=[], pairs=pairs, reps=[],
+                       membership={}, tombstones=set())
+    screen_new_genomes(state, 0, ani_threshold)
+    return clusters_from_state(state)

@@ -56,6 +56,10 @@ def reset_run() -> None:
     from galah_tpu import index as index_pkg
 
     index_pkg.reset()
+    # Fleet-run snapshot (same stdlib-only snapshot-holder shape).
+    from galah_tpu import fleet as fleet_pkg
+
+    fleet_pkg.reset()
 
 
 def finalize(subcommand: str,

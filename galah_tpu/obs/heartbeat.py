@@ -242,6 +242,18 @@ def load(directory: str):
     return atomic.read_jsonl(path)
 
 
+def read_latest_beat(path: str) -> Optional[dict]:
+    """Newest beat record of a run dir's (or file's) heartbeat.jsonl,
+    or None — tolerates missing files and torn tails, never raises.
+    The fleet scheduler's liveness probe reads through this."""
+    try:
+        records, _torn = load(path)
+    except Exception:
+        logger.debug("heartbeat read failed: %s", path, exc_info=True)
+        return None
+    return records[-1] if records else None
+
+
 def render_latest(directory: str) -> str:
     """Human rendering of the newest beat (the `galah-tpu top` body)."""
     path = (os.path.join(directory, HEARTBEAT_FILENAME)

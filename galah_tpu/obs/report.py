@@ -26,7 +26,7 @@ logger = logging.getLogger(__name__)
 
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
                            "run_report.schema.json")
-REPORT_VERSION = 6
+REPORT_VERSION = 7
 
 # disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
 _DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
@@ -199,6 +199,14 @@ def assemble(subcommand: str,
             report["index"] = idx_snap
     except Exception:  # additive section (v5); never lose a report
         logger.debug("index snapshot failed", exc_info=True)
+    try:
+        from galah_tpu import fleet as fleet_pkg
+
+        fleet_snap = fleet_pkg.snapshot()
+        if fleet_snap is not None:
+            report["fleet"] = fleet_snap
+    except Exception:  # additive section (v7); never lose a report
+        logger.debug("fleet snapshot failed", exc_info=True)
     try:
         from galah_tpu.obs import flow as obs_flow
         from galah_tpu.obs import heartbeat as obs_heartbeat
@@ -455,6 +463,27 @@ def render(report: dict) -> str:
             f"{idx.get('pairs', 0)} pair(s), "
             f"{idx.get('tombstones', 0)} tombstone(s)",
         ]
+    fleet = report.get("fleet")
+    if fleet is not None:
+        lines += [
+            "",
+            "fleet:",
+            f"  {fleet.get('n_shards', 0)} shard(s) over "
+            f"{fleet.get('workers', 0)} worker(s): "
+            f"{fleet.get('shards_done', 0)} done, "
+            f"{fleet.get('shards_failed', 0)} failed",
+            f"  {fleet.get('preemptions', 0)} preemption(s), "
+            f"{fleet.get('reassignments', 0)} reassignment(s), "
+            f"retry spend {fleet.get('retry_spend_s', 0)}s, "
+            f"merge wall {fleet.get('merge_wall_s', 0)}s",
+        ]
+        for sh in fleet.get("shards") or []:
+            chain = ",".join(sh.get("preemptions") or []) or "-"
+            lines.append(
+                f"    shard {sh.get('shard_id')} "
+                f"[{sh.get('lo')}:{sh.get('hi')})  "
+                f"{sh.get('status')}  attempts={sh.get('attempts')}  "
+                f"chain={chain}")
     lint = report.get("lint")
     if lint is not None:
         fams = ", ".join(f"{fam}={n}" for fam, n in
@@ -609,6 +638,16 @@ def diff(a: dict, b: dict, label_a: str = "A",
         for key in ("generation", "genomes", "clusters", "pairs",
                     "tombstones"):
             va, vb = int(ia.get(key, 0)), int(ib.get(key, 0))
+            lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
+
+    # fleet drift — additive v7 section, .get throughout
+    fla, flb = a.get("fleet"), b.get("fleet")
+    if fla is not None or flb is not None:
+        fla, flb = fla or {}, flb or {}
+        lines += ["", "fleet drift:"]
+        for key in ("n_shards", "shards_done", "shards_failed",
+                    "preemptions", "reassignments"):
+            va, vb = int(fla.get(key, 0)), int(flb.get(key, 0))
             lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
 
     # flow drift — additive v6 section, .get throughout. A migrated

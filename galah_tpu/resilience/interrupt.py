@@ -74,6 +74,12 @@ _PREV_HANDLERS: Dict[int, Any] = {}
 # here regardless. obs.install_crash_hooks() registers the heartbeat
 # flush; the trace file needs none (flushed per event by design).
 _FLUSH_HOOKS: List[Any] = []
+# Process groups of live fleet workers (pgid == worker pid via
+# start_new_session). The supervisor's second-signal hard exit must
+# not orphan them: the handler forwards SIGTERM to every registered
+# group before os._exit. Main-thread-only like _SIGNALS — the fleet
+# poll loop runs on the main thread.
+_WORKER_GROUPS: List[int] = []
 
 
 def register_flush(fn) -> None:
@@ -81,6 +87,32 @@ def register_flush(fn) -> None:
     exit (idempotent per callable)."""
     if fn not in _FLUSH_HOOKS:
         _FLUSH_HOOKS.append(fn)
+
+
+def register_worker_group(pgid: int) -> None:
+    """Track a live worker process group for signal forwarding."""
+    if pgid not in _WORKER_GROUPS:
+        _WORKER_GROUPS.append(pgid)
+
+
+def unregister_worker_group(pgid: int) -> None:
+    try:
+        _WORKER_GROUPS.remove(pgid)
+    except ValueError:
+        pass
+
+
+def forward_to_worker_groups(signum: int = signal.SIGTERM) -> None:
+    """Forward ``signum`` to every registered worker process group;
+    already-dead groups are skipped silently (signal-path safe)."""
+    for pgid in list(_WORKER_GROUPS):
+        try:
+            os.killpg(pgid, signum)
+        except (ProcessLookupError, PermissionError):
+            pass
+        except Exception:
+            logger.debug("forward to pgid %d failed", pgid,
+                         exc_info=True)
 
 
 def _handler(signum, frame) -> None:
@@ -92,6 +124,9 @@ def _handler(signum, frame) -> None:
         # one last heartbeat/telemetry record when they can.
         logger.error("second signal %s: exiting immediately (%d)",
                      signame, EXIT_PREEMPTED)
+        # Workers first: a supervisor that dies here must not leave
+        # its fleet running against checkpoints it no longer owns.
+        forward_to_worker_groups(signal.SIGTERM)
         for fn in list(_FLUSH_HOOKS):
             try:
                 fn()
@@ -125,6 +160,7 @@ def reset() -> None:
     global _BOUNDARY, _RESUMED_FROM, _PRIOR_INTERRUPTIONS
     _STOP.clear()
     _SIGNALS.clear()
+    _WORKER_GROUPS.clear()
     _BOUNDARY = None
     _RESUMED_FROM = None
     _PRIOR_INTERRUPTIONS = 0

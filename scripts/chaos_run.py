@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from galah_tpu.fleet.plan import PLAN_FILENAME  # noqa: E402
 from galah_tpu.io import atomic  # noqa: E402
 from galah_tpu.resilience.faults import KILL_EXIT_CODE  # noqa: E402
 from galah_tpu.resilience.interrupt import EXIT_PREEMPTED  # noqa: E402
@@ -534,6 +535,305 @@ def run_index_harness(iterations: int, seed: int, workdir: str,
 
 
 # ---------------------------------------------------------------------------
+# Fleet workload
+# ---------------------------------------------------------------------------
+
+#: Fleet interruption modes: SIGKILL a worker's whole process group
+#: (the preempted-node stand-in), SIGKILL the SCHEDULER itself — its
+#: workers survive in their own sessions and the resumed supervisor
+#: must adopt and re-own them — or SIGTERM the scheduler (cooperative
+#: drain: the signal is forwarded to every worker group, everyone
+#: exits 75 at a safe boundary).
+FLEET_MODES = ("worker-kill", "sched-kill", "sched-sigterm")
+
+#: Chaos knobs for every fleet launch: a deep reassignment budget (a
+#: kill/resume chain must never quarantine a healthy shard for being
+#: unlucky), tight poll/heartbeat cadence so preemption detection fits
+#: seconds-scale runs, and deterministic near-zero backoff.
+FLEET_CHAOS_ENV = {
+    "GALAH_TPU_FLEET_RETRY_MAX_ATTEMPTS": "10",
+    "GALAH_TPU_FLEET_RETRY_BASE_DELAY": "0.05",
+    "GALAH_TPU_FLEET_RETRY_MAX_DELAY": "0.2",
+    "GALAH_TPU_FLEET_RETRY_JITTER": "0",
+    "GALAH_TPU_FLEET_POLL_S": "0.1",
+    "GALAH_TPU_FLEET_HEARTBEAT_S": "0.5",
+}
+
+
+def fleet_argv(genomes: List[str], fleet_dir: str, out_tsv: str,
+               report: str, resume: bool, workers: int = 2,
+               shards: int = 3) -> List[str]:
+    argv = [sys.executable, "-m", "galah_tpu.cli", "fleet",
+            "--platform", "cpu", "run",
+            "--genome-fasta-files", *genomes,
+            "--precluster-method", "skani",
+            "--cluster-method", "skani",
+            "--fleet-dir", fleet_dir,
+            "--workers", str(workers),
+            "--shards", str(shards),
+            "--output-cluster-definition", out_tsv,
+            "--run-report", report]
+    if resume:
+        argv.append("--resume")
+    return argv
+
+
+def find_fleet_workers(fleet_dir: str) -> List[int]:
+    """Pids of live fleet WORKER processes (each a session leader,
+    so pid == pgid), found by /proc cmdline: any galah_tpu process
+    whose argv references the fleet's shards/ subtree is a worker —
+    the scheduler references the fleet dir itself, never the
+    subtree."""
+    marker = os.path.join(fleet_dir, "shards") + os.sep
+    pids: List[int] = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                cmdline = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        if marker in cmdline and "galah_tpu" in cmdline:
+            pids.append(int(entry))
+    return sorted(pids)
+
+
+def check_fleet_report(report_path: str, n_shards: int
+                       ) -> Optional[str]:
+    """The completing run's report must carry a coherent fleet
+    section: every shard done, every shard's lifetime launch count
+    equal to its recorded preemption chain plus the one attempt that
+    finished, and the fleet totals equal to the sum of the chains."""
+    try:
+        with open(report_path) as f:
+            rep = json.load(f)
+    except Exception as exc:
+        return f"run report unreadable: {exc}"
+    fleet = rep.get("fleet")
+    if not isinstance(fleet, dict):
+        return "run report has no fleet section"
+    if (fleet.get("n_shards") != n_shards
+            or fleet.get("shards_done") != n_shards):
+        return (f"incomplete fleet: n_shards={fleet.get('n_shards')} "
+                f"shards_done={fleet.get('shards_done')} "
+                f"(expected {n_shards})")
+    if fleet.get("shards_failed"):
+        return f"quarantined shards: {fleet.get('shards_failed')}"
+    chain_total = 0
+    for sh in fleet.get("shards", []):
+        chain = sh.get("preemptions", [])
+        chain_total += len(chain)
+        if sh.get("status") != "done":
+            return (f"shard {sh.get('shard_id')} finished with "
+                    f"status {sh.get('status')!r}")
+        if sh.get("attempts", 0) != len(chain) + 1:
+            return (f"incoherent chain for shard "
+                    f"{sh.get('shard_id')}: {sh.get('attempts')} "
+                    f"attempt(s) vs {len(chain)} preemption(s) "
+                    f"{chain}")
+    if fleet.get("preemptions") != chain_total:
+        return (f"preemption total {fleet.get('preemptions')} != "
+                f"sum of shard chains {chain_total}")
+    if fleet.get("reassignments") != chain_total:
+        return (f"reassignments {fleet.get('reassignments')} != "
+                f"preemption total {chain_total}")
+    san = rep.get("sanitizer")
+    if isinstance(san, dict):
+        for key in ("undeclared_acquisitions", "undeclared_edges",
+                    "inversions", "races"):
+            if san.get(key, 0):
+                return f"sanitizer violation: {key}={san[key]}"
+    return None
+
+
+def run_fleet_iteration(genomes: List[str], reference: bytes,
+                        workdir: str, mode: str, seed: int,
+                        cache_env: Dict[str, str], shards: int = 3
+                        ) -> Tuple[bool, str]:
+    """One fleet kill/resume iteration; returns (ok, detail)."""
+    work = os.path.join(workdir, f"fliter_{seed}_{mode}")
+    os.makedirs(work, exist_ok=True)
+    fleet_dir = os.path.join(work, "fleet")
+    out_tsv = os.path.join(work, "clusters.tsv")
+    report = os.path.join(work, "report.json")
+    log: List[str] = []
+    rng = random.Random(f"chaos-fleet:{seed}:{mode}")
+    env = dict(cache_env)
+    env.update(FLEET_CHAOS_ENV)
+
+    # -- interrupted fleet run ------------------------------------------
+    proc = launch(fleet_argv(genomes, fleet_dir, out_tsv, report,
+                             resume=False, shards=shards), env)
+    if mode == "worker-kill":
+        # wait for workers to appear, then SIGKILL one or two whole
+        # worker process groups at seeded instants (a kill may land
+        # mid-profile, mid-checkpoint-write, or after the worker
+        # already finished — all must be survivable)
+        want = rng.randint(1, 2)
+        killed = 0
+        deadline = time.monotonic() + 60
+        while (killed < want and proc.poll() is None
+               and time.monotonic() < deadline):
+            time.sleep(rng.uniform(0.2, 0.9))
+            workers = find_fleet_workers(fleet_dir)
+            if not workers:
+                continue
+            victim = workers[rng.randrange(len(workers))]
+            try:
+                os.killpg(victim, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                continue
+            killed += 1
+            log.append(f"    SIGKILLed worker group {victim}")
+    else:
+        time.sleep(rng.uniform(1.0, 6.0))
+        if proc.poll() is None:
+            sig = (signal.SIGKILL if mode == "sched-kill"
+                   else signal.SIGTERM)
+            proc.send_signal(sig)
+            log.append(f"    sent {sig.name} to the scheduler process")
+    try:
+        stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return False, "\n".join(
+            log + [f"{mode}: interrupted fleet run hung"])
+    rc = proc.returncode
+    log.append(f"    interrupted fleet run exited {rc}")
+    interrupted = rc != 0
+    # no GALAH_FI faults here, so exit 1 (quarantine) is NOT
+    # acceptable: the reassignment budget must absorb every kill
+    acceptable = {0, EXIT_PREEMPTED, -15, -signal.SIGKILL}
+    if rc not in acceptable:
+        return False, "\n".join(log + [
+            f"{mode}: unexpected exit {rc}",
+            stdout.decode(errors="replace")[-2000:]])
+
+    # -- resume until complete ------------------------------------------
+    for attempt in range(3):
+        if not interrupted:
+            break
+        can_resume = os.path.exists(
+            os.path.join(fleet_dir, PLAN_FILENAME))
+        proc = launch(fleet_argv(genomes, fleet_dir, out_tsv, report,
+                                 resume=can_resume, shards=shards),
+                      env)
+        try:
+            stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            return False, "\n".join(
+                log + [f"{mode}: resumed fleet run hung"])
+        log.append(f"    resume attempt {attempt} exited "
+                   f"{proc.returncode} (resume={can_resume})")
+        if proc.returncode == 0:
+            break
+        if attempt == 2:
+            return False, "\n".join(log + [
+                f"{mode}: fleet never completed "
+                f"(last exit {proc.returncode})",
+                stdout.decode(errors="replace")[-2000:]])
+
+    # -- invariants -----------------------------------------------------
+    if not os.path.exists(out_tsv):
+        return False, "\n".join(
+            log + [f"{mode}: completed fleet left no cluster output"])
+    with open(out_tsv, "rb") as f:
+        out = f.read()
+    if out != reference:
+        return False, "\n".join(log + [
+            f"{mode}: fleet clusters differ from the single-process "
+            f"reference ({len(out)} vs {len(reference)} bytes)"])
+    problems = scan_artifacts(fleet_dir)
+    shards_dir = os.path.join(fleet_dir, "shards")
+    if os.path.isdir(shards_dir):
+        for name in sorted(os.listdir(shards_dir)):
+            sroot = os.path.join(shards_dir, name)
+            problems += scan_artifacts(sroot)
+            problems += scan_artifacts(os.path.join(sroot, "ckpt"))
+    for dirpath, _dirnames, filenames in os.walk(fleet_dir):
+        for fn in filenames:
+            if fn.endswith(".tmp"):
+                p = os.path.join(dirpath, fn)
+                msg = f"leftover tmp debris: {p}"
+                if msg not in problems:
+                    problems.append(msg)
+    if problems:
+        return False, "\n".join(
+            log + [f"{mode}: corrupt fleet artifacts:"] + problems)
+    err = check_fleet_report(report, n_shards=shards)
+    if err:
+        return False, "\n".join(log + [f"{mode}: {err}"])
+    return True, "\n".join(log)
+
+
+def run_fleet_harness(iterations: int, seed: int, workdir: str,
+                      verbose: bool = True) -> int:
+    """Chaos loop over an elastic fleet run; returns FAILED count.
+
+    The reference is the same corpus through ONE single-process
+    ``cluster`` run. Every iteration runs ``fleet run`` sharded 3 ways
+    across 2 workers — 10 genomes in 2 families, so the contiguous
+    shard boundaries land MID-family and the cross-shard merge pairs
+    are real — then SIGKILLs a worker group or the scheduler itself
+    (round-robin over FLEET_MODES: any 3+ iterations kill the
+    scheduler at least once), resumes, and holds the converged fleet
+    to byte-identical output with zero debris and a coherent
+    reassignment chain in the run report."""
+    gdir = os.path.join(workdir, "genomes")
+    os.makedirs(gdir, exist_ok=True)
+    genomes = make_workload(gdir, seed, families=2, members=5,
+                            length=12_000)
+    cache_env = {"GALAH_TPU_CACHE":
+                 os.path.join(workdir, "sketch_cache")}
+
+    ref_work = os.path.join(workdir, "reference")
+    os.makedirs(ref_work, exist_ok=True)
+    ref_tsv = os.path.join(ref_work, "clusters.tsv")
+    proc = launch(cluster_argv(
+        genomes, ref_tsv, os.path.join(ref_work, "ckpt"),
+        os.path.join(ref_work, "report.json"), resume=False),
+        cache_env)
+    stdout, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+    if proc.returncode != 0:
+        print("FATAL: reference run failed:\n"
+              + stdout.decode(errors="replace")[-3000:])
+        return iterations or 1
+    with open(ref_tsv, "rb") as f:
+        reference = f.read()
+    if verbose:
+        nlines = reference.count(b"\n")
+        print(f"reference clustering: {len(reference)} bytes, "
+              f"{nlines} lines")
+
+    rng = random.Random(seed)
+    schedule = [FLEET_MODES[i % len(FLEET_MODES)]
+                for i in range(iterations)]
+    rng.shuffle(schedule)
+    failures = 0
+    for i, mode in enumerate(schedule):
+        ok, detail = run_fleet_iteration(
+            genomes, reference, workdir, mode, seed * 1000 + i,
+            cache_env)
+        status = "PASS" if ok else "FAIL"
+        if verbose or not ok:
+            print(f"[{i + 1:2d}/{iterations}] fleet/{mode:<13s} "
+                  f"{status}")
+            for line in detail.splitlines():
+                if not ok or line.strip().startswith(
+                        ("interrupted", "resume", "SIGKILLed",
+                         "sent")):
+                    print(f"      {line.strip()}")
+        failures += 0 if ok else 1
+    print(f"chaos[fleet]: {iterations - failures}/{iterations} "
+          f"iterations passed")
+    return failures
+
+
+# ---------------------------------------------------------------------------
 # Harness
 # ---------------------------------------------------------------------------
 
@@ -607,12 +907,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="keep the scratch dir for inspection")
     ap.add_argument("--workload", default="cluster",
                     choices=("cluster", "cluster-overlap",
-                             "index-insert"),
+                             "index-insert", "fleet"),
                     help="what to kill: a checkpointed cluster run "
                          "(default), the same run with the overlapped "
-                         "dataflow forced on (cluster-overlap), or an "
+                         "dataflow forced on (cluster-overlap), an "
                          "incremental `index insert` against a "
-                         "prebuilt index")
+                         "prebuilt index, or an elastic multi-worker "
+                         "`fleet run` whose workers AND scheduler get "
+                         "killed (fleet)")
     args = ap.parse_args(argv)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="galah_chaos_")
@@ -620,6 +922,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if args.workload == "index-insert":
             failures = run_index_harness(args.iterations, args.seed,
+                                         workdir)
+        elif args.workload == "fleet":
+            failures = run_fleet_harness(args.iterations, args.seed,
                                          workdir)
         else:
             failures = run_harness(

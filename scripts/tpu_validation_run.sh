@@ -147,6 +147,14 @@ run_stage chaos_smoke 900 env JAX_PLATFORMS=cpu \
 run_stage chaos_overlap 900 env JAX_PLATFORMS=cpu \
   python -u scripts/chaos_run.py --iterations 6 --seed 2 \
   --workload cluster-overlap
+# Elastic-fleet chaos gate: sharded multi-worker runs with SIGKILLed
+# worker groups AND a SIGKILLed/SIGTERMed scheduler, resumed from the
+# event log, must converge byte-identically to the single-process
+# reference with zero tmp debris and a coherent reassignment chain in
+# the run report's fleet section (docs/resilience.md).
+run_stage chaos_fleet 900 env JAX_PLATFORMS=cpu \
+  python -u scripts/chaos_run.py --iterations 10 --seed 3 \
+  --workload fleet
 run_stage test_tpu_hw 2400 env GALAH_RUN_SLOW=1 \
   python -u -m pytest tests/test_tpu_hw.py -q
 run_stage amortized 1800 python -u scripts/bench_amortized.py

@@ -54,6 +54,33 @@ def test_chaos_covers_every_interruption_mode():
     assert set(schedule) == set(chaos_run.MODES)
 
 
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_fleet_smoke(tmp_path):
+    """Bounded fleet chaos: one iteration per FLEET_MODES entry —
+    SIGKILL a worker group, SIGKILL the scheduler, SIGTERM the
+    scheduler — each resumed and held to byte-identical clusters,
+    zero debris, and a coherent reassignment chain (the full
+    10-iteration gate runs in scripts/tpu_validation_run.sh)."""
+    chaos_run = _load_chaos_run()
+    failures = chaos_run.run_fleet_harness(iterations=3, seed=11,
+                                           workdir=str(tmp_path),
+                                           verbose=False)
+    assert failures == 0
+
+
+def test_fleet_schedule_covers_scheduler_kills():
+    """Any 3+ fleet iterations must kill the scheduler itself at
+    least once — worker kills alone never exercise event-log replay
+    or orphan adoption."""
+    chaos_run = _load_chaos_run()
+    for n in (3, 10):
+        schedule = [chaos_run.FLEET_MODES[i % len(chaos_run.FLEET_MODES)]
+                    for i in range(n)]
+        assert "sched-kill" in schedule
+        assert set(schedule) == set(chaos_run.FLEET_MODES)
+
+
 def test_scan_artifacts_flags_debris_and_corruption(tmp_path):
     """The artifact audit itself (fast, not marked chaos): .tmp debris
     and unparseable json are findings; checksum-rejected torn jsonl

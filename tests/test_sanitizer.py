@@ -371,7 +371,7 @@ def test_repo_runs_violation_free_under_sanitizer():
     errs = sanitizer.GLOBAL.errors()
     assert errs == [], json.dumps(errs, indent=1)
     s = sanitizer.GLOBAL.summary()
-    assert s["modules"] == 14  # == len(THREADED_MODULES)
+    assert s["modules"] == 15  # == len(THREADED_MODULES)
     assert s["acquisitions"] > 0
     assert (s["undeclared_acquisitions"] == s["undeclared_edges"]
             == s["inversions"] == s["races"] == 0)
@@ -385,7 +385,7 @@ def test_global_summary_feeds_run_report():
     from galah_tpu.obs import report as report_mod
 
     rep = report_mod.assemble("test", argv=["galah-tpu", "test"])
-    assert rep["version"] == 6
+    assert rep["version"] == 7
     assert rep["sanitizer"]["enabled"] is True
     rendered = report_mod.render(rep)
     assert "concurrency sanitizer (GalahSan):" in rendered
