@@ -893,11 +893,16 @@ def _run_fleet_inner(args) -> int:
     genomes = clusterer.genome_paths
     ani = parse_percentage(args.ani, "--ani")
 
-    workers = (args.workers
-               or int(env_value("GALAH_TPU_FLEET_WORKERS") or 2))
-    n_shards = (args.shards
-                or int(env_value("GALAH_TPU_FLEET_SHARDS") or 0)
+    # only None means unset: `--workers 0` / `--shards 0` must be
+    # rejected below, not silently coerced to the env/default value
+    workers = (args.workers if args.workers is not None
+               else int(env_value("GALAH_TPU_FLEET_WORKERS") or 2))
+    n_shards = (args.shards if args.shards is not None
+                else int(env_value("GALAH_TPU_FLEET_SHARDS") or 0)
                 or workers)
+    if workers < 1:
+        logger.error("--workers must be >= 1, got %d", workers)
+        return 1
     stale_s = (args.stale_s if args.stale_s is not None
                else float(env_value("GALAH_TPU_FLEET_STALE_S") or 30))
     poll_s = float(env_value("GALAH_TPU_FLEET_POLL_S") or 0.2)

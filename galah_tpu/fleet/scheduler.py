@@ -142,6 +142,17 @@ class FleetScheduler:
         self.base_env.pop("GALAH_FI", None)
         self.base_env["GALAH_OBS_HEARTBEAT_S"] = (
             str(self.heartbeat_s) if self.heartbeat_s > 0 else "0")
+        # Orphan adoption must recognise workers launched by a PRIOR
+        # scheduler over the same fleet dir, so the stamp is
+        # deterministic per fleet dir, not per scheduler instance.
+        # Only processes we Popen carry it in their environment —
+        # matching /proc/<pid>/environ instead of cmdline means a
+        # bystander whose argv merely names a shard path (e.g.
+        # `galah-tpu top <fleet_dir>/shards/...`) is never killable.
+        self._worker_stamp = ("GALAH_TPU_FLEET_WORKER="
+                              + os.path.abspath(self.fleet_dir))
+        self.base_env["GALAH_TPU_FLEET_WORKER"] = os.path.abspath(
+            self.fleet_dir)
         self.shards = [_ShardRuntime(spec=s) for s in shards]
         self.preemptions = 0
         self.reassignments = 0
@@ -214,8 +225,8 @@ class FleetScheduler:
     def _sweep_orphans(self) -> None:
         """Belt over the pid bookkeeping: a scheduler killed between
         the pre-act launch record and the pid record leaves a worker
-        no event names. Sweep /proc for processes whose cmdline names
-        OUR shards directory and kill their groups before relaunching
+        no event names. Sweep /proc for processes carrying OUR fleet
+        dir's worker stamp and kill their groups before relaunching
         anything — two writers on one shard checkpoint would race."""
         try:
             pids = [int(p) for p in os.listdir("/proc")
@@ -231,13 +242,14 @@ class FleetScheduler:
                     pass
 
     def _is_our_worker(self, pid: int) -> bool:
+        # environ (NUL-framed, same-uid readable) is spoof-proof where
+        # cmdline is not: only our Popen'd workers inherit the stamp
         try:
-            with open(f"/proc/{pid}/cmdline", "rb") as f:
-                cmdline = f.read().decode("utf-8", "replace")
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env_blob = f.read()
         except OSError:
             return False
-        return (os.path.join(self.fleet_dir, "shards") in cmdline
-                and "galah_tpu" in cmdline)
+        return self._worker_stamp.encode() in env_blob.split(b"\0")
 
     # ------------------------------------------------------- lifecycle
 
@@ -249,6 +261,14 @@ class FleetScheduler:
         # sweep before handing it to the next attempt (the worker's
         # own checkpoint open sweeps the ckpt subdir)
         atomic.sweep_tmp(shard_root(self.fleet_dir, sid))
+        # the previous attempt's heartbeat must not outlive it: left
+        # in place, its last beat reads as instantly-stale before the
+        # new worker's first beat lands (belt over the launch-wall
+        # floor in _poll_one, and keeps `fleet status` beat ages sane)
+        try:
+            os.unlink(shard_heartbeat_path(self.fleet_dir, sid))
+        except OSError:
+            pass
         resume = os.path.exists(os.path.join(
             shard_ckpt_dir(self.fleet_dir, sid), "fingerprint.json"))
         argv = self.worker_argv(rt.spec, resume)
@@ -332,8 +352,13 @@ class FleetScheduler:
             if self.heartbeat_s > 0 and self.stale_s > 0:
                 beat = read_latest_beat(
                     shard_heartbeat_path(self.fleet_dir, sid))
-                ref = (float(beat.get("ts") or 0.0) if beat
-                       else rt.launched_wall)
+                # heartbeat.jsonl can survive a killed attempt (and a
+                # killed scheduler); beats older than THIS attempt's
+                # launch must not age it, or every resumed worker is
+                # stale-killed on the first poll tick
+                ref = rt.launched_wall
+                if beat:
+                    ref = max(ref, float(beat.get("ts") or 0.0))
                 if _wall() - ref > self.stale_s:
                     self._kill_group(rt)
                     self._preempt(rt, "stale-heartbeat")

@@ -272,6 +272,114 @@ def test_scheduler_replays_prior_event_log(tmp_path):
     assert snap["retry_spend_s"] == 0.0
 
 
+def test_stale_probe_ignores_prior_attempt_beats(tmp_path):
+    """heartbeat.jsonl can survive a killed attempt; a resumed
+    scheduler must clock staleness from the CURRENT attempt's launch,
+    not the dead attempt's last beat, or every relaunched worker is
+    stale-killed on the first poll tick before its first beat."""
+    fleet_dir = str(tmp_path)
+    shards = build_plan(["g0.fna", "g1.fna"], 1)
+    sched = FleetScheduler(fleet_dir, shards,
+                           _done_worker_argv(fleet_dir), workers=1,
+                           poll_s=0.02, heartbeat_s=1, stale_s=30,
+                           policy=_fast_policy())
+    hb = fleet_scheduler.shard_heartbeat_path(fleet_dir, 0)
+    os.makedirs(os.path.dirname(hb), exist_ok=True)
+    atomic.append_jsonl(hb, {"beat": 7, "ts": 1.0})  # ancient beat
+
+    class _StillRunning:
+        def poll(self):
+            return None
+
+    rt = sched.shards[0]
+    rt.proc = _StillRunning()
+    rt.pgid = None
+    rt.status = "running"
+    rt.launched_wall = time.time()
+    sched._poll_one(rt)
+    assert rt.status == "running"
+    assert sched.preemptions == 0
+    # the probe still fires once the CURRENT attempt has gone quiet
+    rt.launched_wall = time.time() - 3600
+    sched._poll_one(rt)
+    assert rt.status == "pending"
+    assert rt.preemptions == ["stale-heartbeat"]
+
+
+def test_launch_drops_prior_attempt_heartbeat(tmp_path):
+    fleet_dir = str(tmp_path)
+    shards = build_plan(["g0.fna"], 1)
+    sched = FleetScheduler(fleet_dir, shards,
+                           _done_worker_argv(fleet_dir), workers=1,
+                           poll_s=0.02, heartbeat_s=0,
+                           policy=_fast_policy())
+    hb = fleet_scheduler.shard_heartbeat_path(fleet_dir, 0)
+    os.makedirs(os.path.dirname(hb), exist_ok=True)
+    atomic.append_jsonl(hb, {"beat": 1, "ts": 1.0})
+    rt = sched.shards[0]
+    sched._launch(rt)
+    try:
+        assert not os.path.exists(hb)
+    finally:
+        rt.proc.wait(timeout=10)
+        fleet_scheduler.interrupt.unregister_worker_group(rt.pgid)
+
+
+def test_is_our_worker_requires_env_stamp(tmp_path):
+    """Orphan sweep must match the fleet's env stamp, never argv: a
+    bystander whose cmdline names the shards dir (e.g. `galah-tpu top
+    <fleet_dir>/shards/...`) is not ours and must not be killable."""
+    fleet_dir = str(tmp_path)
+    shards = build_plan(["g0.fna"], 1)
+    sched = FleetScheduler(fleet_dir, shards,
+                           _done_worker_argv(fleet_dir), workers=1,
+                           poll_s=0.02, heartbeat_s=0,
+                           policy=_fast_policy())
+    shard_path = os.path.join(fleet_dir, "shards", "shard_000")
+    clean_env = {k: v for k, v in os.environ.items()
+                 if k != "GALAH_TPU_FLEET_WORKER"}
+    # the ready line proves the child has exec'd: /proc/<pid>/environ
+    # shows the PARENT's image until execve lands
+    ready = "print('ready', flush=True); import time; time.sleep(60)"
+    bystander = subprocess.Popen(
+        [sys.executable, "-c", ready, shard_path, "galah_tpu"],
+        env=clean_env, stdout=subprocess.PIPE)
+    worker = subprocess.Popen(
+        [sys.executable, "-c", ready],
+        env=sched.base_env, stdout=subprocess.PIPE)
+    try:
+        bystander.stdout.readline()
+        worker.stdout.readline()
+        assert sched._is_our_worker(bystander.pid) is False
+        assert sched._is_our_worker(worker.pid) is True
+        assert sched._is_our_worker(2 ** 22 + 1234) is False  # gone
+    finally:
+        for p in (bystander, worker):
+            p.kill()
+            p.wait()
+            p.stdout.close()
+
+
+def test_fleet_run_rejects_zero_workers(tmp_path, capsys):
+    """`--workers 0` is an error, not a silent fall-through to the
+    env/default value (0 is falsy; only None means unset)."""
+    from galah_tpu.cli import main
+
+    p = str(tmp_path / "g0.fna")
+    with open(p, "w") as f:
+        f.write(">c1\n" + "ACGT" * 50 + "\n")
+    rc = main(["fleet", "--platform", "cpu", "run",
+               "--genome-fasta-files", p,
+               "--precluster-method", "skani",
+               "--cluster-method", "skani",
+               "--workers", "0",
+               "--fleet-dir", str(tmp_path / "fleet"),
+               "--output-cluster-definition",
+               str(tmp_path / "clusters.tsv")])
+    assert rc == 1
+    assert "--workers must be >= 1" in capsys.readouterr().err
+
+
 # -- merge -----------------------------------------------------------
 
 
