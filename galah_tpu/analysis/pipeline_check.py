@@ -12,10 +12,14 @@ Annotation: a pipeline module opts in with a module-level literal
     PIPELINE_STAGE = {
         "streaming": ["iter_path_sketches"],          # generator stages
         "occupancy_gauge": "workload.pipeline_occupancy",
+        "device_round": ["_slab_fold_jit"],           # sync-free bodies
     }
 
 ``streaming`` names this module's generator stages (GL1002 scope);
-``occupancy_gauge`` contracts the module to emit that gauge (GL1004).
+``occupancy_gauge`` contracts the module to emit that gauge (GL1004);
+``device_round`` names functions that must stay device-resident —
+bodies that run inside the persistent greedy round / megakernel and
+therefore may never force a host round-trip (GL1006 scope).
 
 Checks
   GL1001  full materialization of a streaming iterator:
@@ -40,8 +44,17 @@ Checks
           the ``PIPELINE_OCCUPANCY_GAUGE`` constant), so the occupancy
           dashboard the pipelining work gates on stays dark.
   GL1005  malformed ``PIPELINE_STAGE`` annotation: not a dict literal,
-          unknown keys, a ``streaming`` entry that is not a function
-          defined in the module, or a non-string gauge name.
+          unknown keys, a ``streaming`` / ``device_round`` entry that
+          is not a function defined in the module, or a non-string
+          gauge name.
+  GL1006  host synchronization inside a declared device-round body:
+          ``np.asarray`` / ``.item()`` / ``jax.device_get`` /
+          ``block_until_ready`` in a function listed in
+          ``PIPELINE_STAGE["device_round"]``. Those bodies are traced
+          into the persistent round program — a host sync there either
+          fails tracing or, worse, silently splits the megakernel back
+          into per-window dispatches and the dispatch-count win
+          evaporates. Convert at the wrapper boundary instead.
 
 Suppression: the usual inline comment with a justification —
 
@@ -71,10 +84,17 @@ MATERIALIZERS = frozenset({"list", "sorted", "tuple"})
 #: Host-sync calls GL1002 bans inside declared streaming stages.
 SYNC_CALLS = frozenset({"block_until_ready", "device_get"})
 
+#: Host-sync calls GL1006 bans inside declared device-round bodies.
+#: ``asarray`` covers the np.asarray(device_array) idiom and ``item``
+#: the scalar pull — both force a transfer mid-trace.
+DEVICE_ROUND_SYNC_CALLS = frozenset({
+    "asarray", "item", "device_get", "block_until_ready"})
+
 #: The one registered occupancy gauge (obs/metrics.py re-exports it).
 OCCUPANCY_GAUGE = "workload.pipeline_occupancy"
 
-_ANNOTATION_KEYS = frozenset({"streaming", "occupancy_gauge"})
+_ANNOTATION_KEYS = frozenset({"streaming", "occupancy_gauge",
+                              "device_round"})
 
 _EXEMPT_PREFIXES = ("galah_tpu/utils/", "galah_tpu/obs/",
                     "galah_tpu/analysis/")
@@ -167,6 +187,31 @@ def _check_streaming_sync(src: SourceFile, streaming: List[str],
                              f"{name}(): a host sync serializes the "
                              "device/host overlap the stage is "
                              "declared to provide"),
+                    symbol=name))
+    return out
+
+
+def _check_device_round_sync(src: SourceFile, device_round: List[str],
+                             defs: Dict[str, ast.AST]) -> List[Finding]:
+    """GL1006: host sync inside a declared device-round body."""
+    out: List[Finding] = []
+    for name in device_round:
+        fn = defs.get(name)
+        if fn is None:
+            continue  # GL1005 reports the dangling annotation
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            called = dotted_name(node.func).rsplit(".", 1)[-1]
+            if called in DEVICE_ROUND_SYNC_CALLS:
+                out.append(Finding(
+                    code="GL1006", severity=Severity.WARNING,
+                    path=src.path, line=node.lineno,
+                    message=(f"{called}() inside device-round body "
+                             f"{name}(): a host round-trip here splits "
+                             "the persistent round program back into "
+                             "per-window dispatches; convert at the "
+                             "wrapper boundary instead"),
                     symbol=name))
     return out
 
@@ -316,6 +361,27 @@ def check_pipeline_file(src: SourceFile) -> List[Finding]:
                          "module"),
                 symbol=name))
     out.extend(_check_streaming_sync(src, streaming, defs))
+
+    device_round = stage.get("device_round", [])
+    if (not isinstance(device_round, list)
+            or not all(isinstance(s, str) for s in device_round)):
+        out.append(Finding(
+            code="GL1005", severity=Severity.WARNING, path=src.path,
+            line=line,
+            message="PIPELINE_STAGE['device_round'] must be a list of "
+                    "function names",
+            symbol="PIPELINE_STAGE"))
+        device_round = []
+    for name in device_round:
+        if name not in defs:
+            out.append(Finding(
+                code="GL1005", severity=Severity.WARNING,
+                path=src.path, line=line,
+                message=(f"PIPELINE_STAGE['device_round'] names "
+                         f"{name}(), which is not defined in this "
+                         "module"),
+                symbol=name))
+    out.extend(_check_device_round_sync(src, device_round, defs))
 
     gauge = stage.get("occupancy_gauge")
     if gauge is not None:

@@ -219,6 +219,15 @@ def cluster(
 
         strategy, explicit = resolve_greedy_strategy()
         timing.counter(f"greedy-strategy-{strategy}", 1)
+        from galah_tpu.ops.megakernel import resolve_megakernel
+
+        mk_mode, _mk_explicit = resolve_megakernel()
+        if mk_mode == "1" and strategy != "device":
+            raise RuntimeError(
+                "GALAH_TPU_MEGAKERNEL=1 requires the device greedy "
+                f"strategy; GALAH_TPU_GREEDY_STRATEGY pins {strategy!r}"
+                " — the fused slab fold only exists inside device "
+                "rounds")
         pending = [(i, m) for i, m in enumerate(preclusters)
                    if i not in done]
         device_done: Optional[Dict[int, List[List[int]]]] = None
@@ -508,6 +517,153 @@ class _OverlapState:
         self.eager_rounds = 0
 
 
+class _MegaCtx:
+    """Run-scoped megakernel strategy state (ops/megakernel.py).
+
+    ``active`` drops to False when an AUTO run demotes — the rest of
+    the run takes the per-window dense fold. ``dev_busy`` accumulates
+    the device-dispatch bracket wall so the greedy stage's recorded
+    service stays net of device time (the flow host-blame share keys
+    off this split)."""
+
+    def __init__(self, explicit: bool, cap: int, queue) -> None:
+        self.explicit = explicit
+        self.active = True
+        self.cap = cap
+        self.queue = queue
+        self.dev_busy = 0.0
+
+
+def _megakernel_ctx(stage_serial: bool = False) -> Optional[_MegaCtx]:
+    """The megakernel context for one clustering run, or None when it
+    should not engage. Callers are device-round engines; forced-mode
+    ineligibility (host greedy strategy) is enforced at the strategy
+    dispatch in cluster().
+
+    AUTO engages only in the overlapped engine: that is the e2e path
+    whose host round-trips the megakernel removes, and its eager-round
+    cadence is already arrival-driven. The stage-serial engine keeps
+    its round-per-window cadence under AUTO — one durable checkpoint
+    record, one preemption boundary, and one backend-call pattern per
+    round window is a contract resume tooling observes — and opts into
+    slab-fused rounds only under an explicit GALAH_TPU_MEGAKERNEL=1
+    (still durable and replayable, per slab)."""
+    from galah_tpu.ops import device_queue
+    from galah_tpu.ops.megakernel import resolve_megakernel
+
+    mode, _explicit = resolve_megakernel()
+    if mode == "0" or (stage_serial and mode != "1"):
+        return None
+    cap = device_queue.resolve_queue_cap()
+    return _MegaCtx(mode == "1", cap, device_queue.PairQueue(cap))
+
+
+def _grow_slab(seq, pos: int, width: int, adj: Dict[int, List[int]],
+               cap: int, ready_limit: Optional[int] = None) -> List[int]:
+    """Fuse up to megakernel.SLAB_WINDOWS consecutive round windows
+    starting at ``pos`` into one slab, while the intra-slab hit-edge
+    count stays within the queue capacity (the estimate counts every
+    hit pair; the enqueued set — non-None values only — is a subset,
+    so a fitting estimate can never overflow). Width invariance of the
+    round machinery makes the slab's decisions bit-identical to its
+    sequential windows. ``ready_limit`` (the overlapped engine's
+    resolved-prefix frontier) stops growth at windows not yet final."""
+    from galah_tpu.ops.megakernel import SLAB_WINDOWS
+
+    n = len(seq)
+    window = list(seq[pos:pos + width])
+    # membership-test only (never iterated): hash order cannot leak
+    slab_members = set(window)
+    edges = sum(1 for g in window
+                for t in adj[g] if t in slab_members) // 2
+    k = 1
+    while k < SLAB_WINDOWS:
+        nstart = pos + len(window)
+        if nstart >= n:
+            break
+        nend = min(nstart + width, n)
+        if ready_limit is not None and ready_limit < nend:
+            break
+        nxt = list(seq[nstart:nend])
+        nxt_members = set(nxt)
+        grown = sum(1 for g in nxt for t in adj[g] if t in slab_members)
+        grown += sum(1 for g in nxt for t in adj[g]
+                     if t in nxt_members) // 2
+        if edges + grown > cap:
+            break
+        edges += grown
+        window += nxt
+        slab_members |= nxt_members
+        k += 1
+    return window
+
+
+def _megakernel_fold(mega: _MegaCtx, window: List[int],
+                     win_pos: Dict[int, int],
+                     adj: Dict[int, List[int]], ext, value, thr: float,
+                     np):
+    """Queue-fed slab fold: enqueue the slab's materialized hit edges
+    into the on-device pair queue and run the fused fold program
+    (ops/megakernel.slab_select) in place of one dense window fold per
+    round window. Returns ``(rep_flags, converged)``, or
+    ``(None, False)`` when the slab spilled (queue capacity) or an
+    AUTO run demoted — the caller then takes the exact dense path."""
+    from galah_tpu.obs import flow as obs_flow
+    from galah_tpu.ops import megakernel as mk
+
+    ei: List[int] = []
+    ej: List[int] = []
+    ev: List[float] = []
+    for wi, g in enumerate(window):
+        for t in adj[g]:
+            ti = win_pos.get(t)
+            if ti is None or ti <= wi:
+                continue
+            v = value(g, t)
+            if v is None:
+                continue
+            ei.append(wi)
+            ej.append(ti)
+            ev.append(v)
+    if len(ei) > mega.cap:
+        timing.counter("megakernel-overflow-spills", 1)
+        return None, False
+    try:
+        with obs_flow.blocked("greedy", "device-dispatch") as bdev:
+            rep, converged = mk.slab_select(
+                mega.queue, np.asarray(ei, dtype=np.int32),
+                np.asarray(ej, dtype=np.int32),
+                np.asarray(ev, dtype=np.float64),
+                np.asarray(ext, dtype=bool), thr)
+        mega.dev_busy += bdev.seconds
+    except interrupt.PreemptionRequested:
+        raise  # a stop request is never a demotion signal
+    except Exception as e:  # noqa: BLE001 - AUTO demotes
+        if mega.explicit:
+            raise
+        _demote_megakernel(mega, f"{type(e).__name__}: {e}")
+        return None, False
+    if rep is None:
+        timing.counter("megakernel-overflow-spills", 1)
+        return None, False
+    timing.counter("megakernel-slab-folds", 1)
+    return rep, converged
+
+
+def _demote_megakernel(mega: _MegaCtx, error: str) -> None:
+    """AUTO demotion: the rest of the run takes the per-window dense
+    fold; the demotion is counted and event-logged like the overlap
+    and greedy-strategy demotions."""
+    logger.warning(
+        "megakernel slab fold failed (%s); demoting to the per-window "
+        "dense fold for this run", error)
+    timing.counter("megakernel-demoted", 1)
+    from galah_tpu.obs import events
+
+    events.record("megakernel-demoted", error=error)
+    mega.active = False
+
+
 def _overlap_mode() -> str:
     from galah_tpu.config import env_value
 
@@ -779,16 +935,30 @@ def _cluster_overlapped(
             flush_spec()
 
     frontier = [0]  # next undecided window start: prefix is FINAL
+    mega = _megakernel_ctx()
+    seq_all = range(n)
 
     def run_ready_windows(r1: int) -> None:
         while frontier[0] < n:
             end = min(frontier[0] + width, n)
             if r1 < end:
                 return
-            window = list(range(frontier[0], end))
+            if mega is not None and mega.active:
+                # fuse every already-ready consecutive window into one
+                # queue-fed slab round (bit-identical by width
+                # invariance) — eagerness is unchanged because growth
+                # stops at the resolved prefix (r1), never waiting for
+                # windows the stream has not finalized
+                window = _grow_slab(seq_all, frontier[0], width, adj,
+                                    mega.cap, ready_limit=r1)
+                end = frontier[0] + len(window)
+            else:
+                window = list(range(frontier[0], end))
+            n_windows = (len(window) + width - 1) // width
             t0 = time.monotonic()
             fid = obs_flow.begin("greedy_round")
             fb0 = frag_busy[0]
+            db0 = mega.dev_busy if mega is not None else 0.0
             pc_of = {g: find(g) for g in window}
             reps_by_pc: Dict[int, List[int]] = {}
             for r in st.rep_order:
@@ -799,12 +969,12 @@ def _cluster_overlapped(
                 _device_round(window, pc_of, adj, reps_by_pc, rep_set,
                               batch, value, consulted, thr,
                               greedy_select, np, conflicts_c,
-                              fallback_c)
+                              fallback_c, mega=mega)
                 timing.counter("greedy-rounds", 1)
                 rounds_c.inc()
-            timing.counter("overlap-eager-rounds", 1)
-            eager_c.inc()
-            st.eager_rounds += 1
+            timing.counter("overlap-eager-rounds", n_windows)
+            eager_c.inc(n_windows)
+            st.eager_rounds += n_windows
             # _device_round appends reps in window order; every window
             # genome was undecided before, so the in-rep_set window
             # genomes ARE this round's commits, in commit order
@@ -816,7 +986,10 @@ def _cluster_overlapped(
                 for t in adj[r]:
                     offer((r, t))
             frontier[0] = end
-            dt = ((time.monotonic() - t0) - (frag_busy[0] - fb0))
+            dev_dt = ((mega.dev_busy - db0)
+                      if mega is not None else 0.0)
+            dt = ((time.monotonic() - t0) - (frag_busy[0] - fb0)
+                  - dev_dt)
             greedy_busy[0] += dt
             obs_flow.record_service("greedy", dt)
             obs_flow.complete(fid)
@@ -1123,16 +1296,24 @@ def _cluster_pending_rounds(
         help="Round windows finished by the exact host-order scan",
         unit="windows")
 
+    mega = _megakernel_ctx(stage_serial=True)
     n = len(seq)
     pos = 0
     while pos < n:
-        window = seq[pos:pos + width]
+        if mega is not None and mega.active:
+            # fuse consecutive ready windows into one queue-fed slab
+            # round (bit-identical by width invariance; capacity- and
+            # SLAB_WINDOWS-bounded). Checkpoint records stay per
+            # round, so resume replay is granularity-agnostic.
+            window = _grow_slab(seq, pos, width, adj, mega.cap)
+        else:
+            window = seq[pos:pos + width]
         pos += len(window)
         with hist.time():
             rstart = len(computed)
             _device_round(window, pc_of, adj, reps_by_pc, rep_set,
                           batch, value, consulted, thr, greedy_select,
-                          np, conflicts_c, fallback_c)
+                          np, conflicts_c, fallback_c, mega=mega)
             timing.counter("greedy-rounds", 1)
             rounds_c.inc()
             if checkpoint and len(computed) > rstart:
@@ -1224,6 +1405,7 @@ def _device_round(
     np,
     conflicts_c,
     fallback_c,
+    mega: Optional[_MegaCtx] = None,
 ) -> None:
     """Resolve one K-genome window; commits new reps into reps_by_pc.
 
@@ -1304,21 +1486,42 @@ def _device_round(
                 decided[ti] = True
     timing.counter("greedy-subrounds", n_sub)
 
-    # (3) the jitted fold over the materialized intra-window matrix.
-    # Soundness gate: the fold is only authoritative when bookkeeping
+    # (3) the jitted fold as the authoritative device decision pass.
+    # Soundness gate: a fold is only authoritative when bookkeeping
     # is COMPLETE — over an incompletely materialized matrix, missing
     # edges read as no-edge and a converged fold can still be wrong.
+    # With the megakernel engaged, a complete slab folds via the
+    # queue-fed fused program (2 dispatches per slab instead of one
+    # dense fold per window); spills/demotions fall through to the
+    # dense path, so decisions stay exact either way.
     complete = bool(decided.all())
-    mat = np.full((w, w), np.nan, dtype=np.float64)
-    for wi, g in enumerate(window):
-        for t in adj[g]:
-            ti = win_pos.get(t)
-            if ti is None or ti <= wi:
-                continue
-            v = value(g, t)
-            if v is not None:
-                mat[wi, ti] = v
-    rep_flags, converged = greedy_select.window_select(mat, ext, thr)
+    rep_flags = None
+    if complete and mega is not None and mega.active:
+        rep_flags, converged = _megakernel_fold(
+            mega, window, win_pos, adj, ext, value, thr, np)
+        if rep_flags is not None and (
+                not converged
+                or not np.array_equal(rep_flags, tentative)):
+            if mega.explicit:
+                raise RuntimeError(
+                    "megakernel slab fold disagreed with the exact "
+                    "sub-round bookkeeping — refusing speculative "
+                    "greedy decisions")
+            _demote_megakernel(
+                mega, "slab fold disagreed with sub-round bookkeeping")
+            rep_flags = None
+    if rep_flags is None:
+        mat = np.full((w, w), np.nan, dtype=np.float64)
+        for wi, g in enumerate(window):
+            for t in adj[g]:
+                ti = win_pos.get(t)
+                if ti is None or ti <= wi:
+                    continue
+                v = value(g, t)
+                if v is not None:
+                    mat[wi, ti] = v
+        rep_flags, converged = greedy_select.window_select(mat, ext,
+                                                          thr)
     if complete:
         if not converged or not np.array_equal(rep_flags, tentative):
             raise RuntimeError(

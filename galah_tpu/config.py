@@ -182,6 +182,26 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "fragment-ANI batch launches in the overlapped "
               "dataflow; bounds the in-flight window (memory stays "
               "O(depth))"),
+    Flag("GALAH_TPU_MEGAKERNEL", section="kernel", default="auto",
+         choices=("auto", "0", "1"),
+         help="Fused device-resident greedy rounds (docs/dataflow.md "
+              "'Persistent device rounds'): consecutive round windows "
+              "fuse into one slab whose surviving pairs enqueue into "
+              "the on-device work queue and resolve with one fused "
+              "fold program — 2 dispatches per slab instead of one "
+              "window fold each, bit-identical decisions. auto "
+              "engages inside device greedy rounds and demotes to "
+              "the per-window dense fold on failure; 1 forces it "
+              "(failures and ineligibility propagate); 0 disables "
+              "it"),
+    Flag("GALAH_TPU_QUEUE_CAP", kind="int", default="4096",
+         section="kernel",
+         help="Capacity (pairs) of the on-device megakernel work "
+              "queue, rounded up to a power of two. Slabs whose "
+              "surviving-pair count exceeds it spill to the exact "
+              "per-window dense path (megakernel-overflow-spills "
+              "counter) — results are exact at any value; the knob "
+              "only moves the spill boundary"),
     Flag("GALAH_TPU_MESH_SHAPE", section="kernel", default="auto",
          help="Device-mesh geometry for the all-pairs distance passes "
               "(docs/DISTRIBUTED.md): 'auto' picks the squarest RxC "

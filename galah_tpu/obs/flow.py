@@ -542,6 +542,24 @@ def critical_path(snap: dict, e2e_wall_s: float) -> dict:
             "wait_s": dict(st.get("wait_s") or {}),
         }
     out["bottleneck"] = max(blame, key=lambda s: blame[s])
+    # host decomposition (the megakernel's headline gauge): each
+    # stage's blame splits by its busy composition — the
+    # device-dispatch wait bracket is device time, everything else
+    # (service + host/lock waits) is host orchestration. flow.host.
+    # share is the fraction of the e2e wall blamed on host work;
+    # driving it down is what collapsing the per-round host
+    # round-trips buys (ledger direction: lower-better).
+    host_blame = 0.0
+    for s, amount in blame.items():
+        st = stages.get(s) or {}
+        waits = dict(st.get("wait_s") or {})
+        waits.pop("upstream-empty", None)
+        dev = float(waits.get("device-dispatch", 0.0))
+        busy = float(st.get("service_s") or 0.0) + sum(waits.values())
+        host_frac = (busy - dev) / busy if busy > 0 else 1.0
+        host_blame += amount * host_frac
+    out["host"] = {"blame_s": round(host_blame, 6),
+                   "share": round(host_blame / wall, 6)}
     return out
 
 
@@ -560,6 +578,12 @@ def render_critical_path(cp: dict, indent: str = "") -> List[str]:
     bn_share = (st.get(bn, {}).get("share") or 0.0) if bn else 0.0
     lines.append(f"{indent}  bottleneck: {bn} "
                  f"({100.0 * bn_share:.0f}% of wall)")
+    host = cp.get("host") or {}
+    if host:
+        lines.append(
+            f"{indent}  host blame: {host.get('blame_s') or 0.0:.2f}s "
+            f"({100.0 * (host.get('share') or 0.0):.0f}% of wall; "
+            "the rest sits in device-dispatch brackets)")
     lines.append(f"{indent}  {'stage':<10} {'blame':>8} {'share':>6} "
                  f"{'service':>8}  wait(top reason)")
     covered = 0.0

@@ -67,6 +67,15 @@ _HIGHER_BETTER = ("per_sec", "per_s", "_rate", "speedup",
                   "utilization", "hit_rate")
 _LOWER_BETTER = ("_s", "duration", "seconds", "wall", "_bytes",
                  "bytes_", "errors")
+#: Exact-name directions checked before the substring families. The
+#: host-blame share is the megakernel's headline gauge: host
+#: orchestration migrating back above its ledger median is a
+#: regression even though "share" matches no substring family.
+_DIRECTION_OVERRIDES = {
+    "flow.host.share": "lower",
+    "flow.host.blame_s": "lower",
+    "bench.megakernel_host_share": "lower",
+}
 
 
 def git_sha() -> Optional[str]:
@@ -88,8 +97,14 @@ def metric_direction(name: str) -> str:
     """'higher' / 'lower' / 'neutral' — which way is good for `name`.
 
     Inferred from naming conventions (rates up, walls and byte counts
-    down); unknown metrics are 'neutral' and can drift but never gate."""
+    down); unknown metrics are 'neutral' and can drift but never gate.
+    A few metrics carry an exact-name direction (see
+    _DIRECTION_OVERRIDES) where the convention families would miss or
+    misread them."""
     low = name.lower()
+    override = _DIRECTION_OVERRIDES.get(low)
+    if override is not None:
+        return override
     if any(tok in low for tok in _HIGHER_BETTER):
         return "higher"
     if any(low.endswith(tok) or tok in low for tok in _LOWER_BETTER):
@@ -240,6 +255,10 @@ def metrics_of_report(report: dict) -> Dict[str, float]:
             v = blame.get(field)
             if isinstance(v, (int, float)):
                 out[f"flow.{name}.{field}"] = float(v)
+    for field in ("blame_s", "share"):
+        v = (cp.get("host") or {}).get(field)
+        if isinstance(v, (int, float)):
+            out[f"flow.host.{field}"] = float(v)
     return out
 
 

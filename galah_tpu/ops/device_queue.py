@@ -1,0 +1,254 @@
+"""Fixed-capacity on-device pair work queue (the megakernel substrate).
+
+The host↔device boundary is the dispatch lever (PR 6: ~250→~16 groups
+per round; PR 14: host orchestration, not device math, dominates the
+e2e wall). The queue removes the per-round surviving-pair round-trip:
+pairs that survive the screen are *enqueued on device* as compacted
+``(i, j, ani)`` triples and consumed there by the fused slab fold
+(ops/megakernel.py) — the surviving pair list of a round never
+materializes on host.
+
+Layout: a power-of-two-capacity ring of three parallel buffers
+(``qi``/``qj`` int32, ``qv`` float64) plus two device scalars, the
+compacted entry count and a cumulative overflow counter. Invariants
+the megakernel relies on (tested in tests/test_megakernel.py):
+
+  * **Compaction** — entries always occupy the dense prefix
+    ``[0, count)``; :func:`enqueue` scatters each batch at
+    ``count + cumsum(mask) - 1``, so a consumer needs only ``count``,
+    never a validity scan.
+  * **Bounded, exact overflow** — an enqueue that would pass capacity
+    stores the prefix that fits and counts the rest in ``overflow``;
+    the returned stored-mask tells the producer exactly which pairs
+    must take the host spill path, so results stay exact at ANY
+    capacity (the overflow-capacity parity sweep pins this).
+  * **Pow2 bucketing** — enqueue batches pad to power-of-two buckets
+    (same ``_bucket`` discipline as ops/greedy_select), so a run
+    compiles O(log cap) enqueue variants, not one per batch size
+    (GL3xx recompile-churn budget).
+
+The drain walks the compacted index in a ``lax.while_loop`` (block
+copies until ``count`` is passed) — used by the spill path and tests;
+the megakernel's fold consumes the buffers in place without draining.
+
+Capacity comes from ``GALAH_TPU_QUEUE_CAP`` (default 4096 pairs,
+rounded up to a power of two; docs/dataflow.md has the flag table).
+
+Bit-identity contract: ``qv`` is float64 end to end and the queue
+never transforms values — it stores and returns the exact IEEE bits
+the screen produced (same contract as ops/greedy_select).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galah_tpu.obs.profile import profiled
+from galah_tpu.utils import timing
+
+jax.config.update("jax_enable_x64", True)
+
+logger = logging.getLogger(__name__)
+
+#: Default queue capacity in pairs (GALAH_TPU_QUEUE_CAP overrides;
+#: rounded up to a power of two, floor _MIN_CAP).
+DEFAULT_QUEUE_CAP = 4096
+_MIN_CAP = 8
+
+#: Entries copied per drain while_loop iteration.
+_DRAIN_BLOCK = 64
+
+# Numeric-determinism contract checked by `galah-tpu lint` (GL9xx):
+# the queue stores decision values verbatim — no accumulation, no
+# dtype change — so the fold downstream compares the same f64 bits
+# the host path would.
+DETERMINISM_CONTRACT = {
+    "family": "device_queue",
+    "dtype": "float64",
+    "functions": ["_enqueue_jit", "_drain_jit"],
+}
+
+# Pipeline-discipline annotation (GL10xx): the jitted queue programs
+# are device-round bodies — host-sync calls inside them would
+# reintroduce the per-round round-trip the queue exists to remove
+# (GL1006).
+PIPELINE_STAGE = {  # galah-lint: ignore[GL704] the engine owns flow attribution
+    "device_round": ["_enqueue_jit", "_drain_jit"],
+}
+
+
+def resolve_queue_cap() -> int:
+    """Queue capacity from GALAH_TPU_QUEUE_CAP, power-of-two rounded.
+
+    Malformed or non-positive values fall back to the default with a
+    warning (never an error: capacity only moves the spill boundary,
+    results are exact at any value)."""
+    raw = (os.environ.get("GALAH_TPU_QUEUE_CAP") or "").strip()
+    cap = DEFAULT_QUEUE_CAP
+    if raw:
+        try:
+            cap = int(raw)
+        except ValueError:
+            logger.warning("ignoring malformed GALAH_TPU_QUEUE_CAP=%r "
+                           "(want a positive integer)", raw)
+            cap = DEFAULT_QUEUE_CAP
+        if cap < 1:
+            logger.warning("ignoring non-positive GALAH_TPU_QUEUE_CAP"
+                           "=%d", cap)
+            cap = DEFAULT_QUEUE_CAP
+    return _pow2_at_least(cap)
+
+
+def _pow2_at_least(n: int) -> int:
+    b = _MIN_CAP
+    while b < n:
+        b *= 2
+    return b
+
+
+@profiled("queue.enqueue")
+@jax.jit
+def _enqueue_jit(qi: jax.Array, qj: jax.Array, qv: jax.Array,
+                 count: jax.Array, overflow: jax.Array,
+                 i: jax.Array, j: jax.Array, v: jax.Array,
+                 valid: jax.Array):
+    """Scatter one batch into the compacted prefix.
+
+    ``valid`` masks batch padding. Each valid entry lands at
+    ``count + (its rank among valid entries)``; entries whose slot
+    would pass capacity are dropped (out-of-range scatter with
+    ``mode='drop'``) and counted in ``overflow``. Returns the updated
+    buffers/scalars plus the stored-mask."""
+    cap = qi.shape[0]
+    slots = count + jnp.cumsum(valid.astype(count.dtype)) - 1
+    stored = valid & (slots < cap)
+    idx = jnp.where(stored, slots, cap)  # cap == dropped
+    qi = qi.at[idx].set(i, mode="drop")
+    qj = qj.at[idx].set(j, mode="drop")
+    qv = qv.at[idx].set(v, mode="drop")
+    n_stored = jnp.sum(stored)
+    n_valid = jnp.sum(valid)
+    return (qi, qj, qv, count + n_stored,
+            overflow + (n_valid - n_stored), stored)
+
+
+@profiled("queue.drain")
+@jax.jit
+def _drain_jit(qi: jax.Array, qj: jax.Array, qv: jax.Array,
+               count: jax.Array):
+    """Compacted-index drain: a ``lax.while_loop`` walks the dense
+    prefix in ``_DRAIN_BLOCK``-entry copies until ``count`` is passed.
+    Slots past ``count`` come back as (0, 0, NaN) — never consumable
+    (NaN compares False against any threshold)."""
+    cap = qi.shape[0]
+    oi = jnp.zeros(cap, dtype=qi.dtype)
+    oj = jnp.zeros(cap, dtype=qj.dtype)
+    ov = jnp.full(cap, jnp.nan, dtype=qv.dtype)
+
+    def cond(carry):
+        k = carry[0]
+        return k < count
+
+    def body(carry):
+        k, oi, oj, ov = carry
+        idx = k + jnp.arange(_DRAIN_BLOCK)
+        take = idx < count
+        src = jnp.minimum(idx, cap - 1)
+        tgt = jnp.where(take, idx, cap)  # cap == dropped
+        oi = oi.at[tgt].set(qi[src], mode="drop")
+        oj = oj.at[tgt].set(qj[src], mode="drop")
+        ov = ov.at[tgt].set(qv[src], mode="drop")
+        return k + _DRAIN_BLOCK, oi, oj, ov
+
+    _, oi, oj, ov = jax.lax.while_loop(
+        cond, body, (jnp.zeros_like(count), oi, oj, ov))
+    return oi, oj, ov
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_CAP
+    while b < n:
+        b *= 2
+    return b
+
+
+class PairQueue:
+    """Host handle over the device-resident queue buffers.
+
+    The buffers live as jax arrays across enqueues — between the
+    screen and the fold nothing transfers back to host. The host-side
+    methods do the padding/bucketing and the (intentional, measured)
+    scalar reads; the jitted bodies above stay sync-free (GL1006).
+    """
+
+    def __init__(self, cap: int = None) -> None:
+        if cap is None:
+            cap = resolve_queue_cap()
+        self.cap = _pow2_at_least(int(cap))
+        self._qi = jnp.zeros(self.cap, dtype=jnp.int32)
+        self._qj = jnp.zeros(self.cap, dtype=jnp.int32)
+        self._qv = jnp.full(self.cap, jnp.nan, dtype=jnp.float64)
+        self._count = jnp.zeros((), dtype=jnp.int64)
+        self._overflow = jnp.zeros((), dtype=jnp.int64)
+
+    @property
+    def count(self) -> int:
+        return int(self._count)
+
+    @property
+    def overflow(self) -> int:
+        return int(self._overflow)
+
+    def enqueue(self, i: np.ndarray, j: np.ndarray,
+                v: np.ndarray) -> int:
+        """Append one batch of pairs; returns how many were stored.
+
+        Pads the batch to a power-of-two bucket (masked) so repeated
+        enqueues reuse a handful of compiled variants. A return below
+        ``len(i)`` means the queue hit capacity mid-batch: the stored
+        prefix is in the queue, the rest counted in ``overflow`` —
+        the producer spills those to the host path."""
+        m = len(i)
+        if m == 0:
+            return 0
+        b = _bucket(m)
+        ip = np.zeros(b, dtype=np.int32)
+        jp = np.zeros(b, dtype=np.int32)
+        vp = np.full(b, np.nan, dtype=np.float64)
+        maskp = np.zeros(b, dtype=bool)
+        ip[:m], jp[:m], vp[:m] = i, j, v
+        maskp[:m] = True
+        timing.dispatch(1)
+        timing.counter("greedy-select-dispatches", 1)
+        (self._qi, self._qj, self._qv, self._count, self._overflow,
+         stored) = _enqueue_jit(
+            self._qi, self._qj, self._qv, self._count, self._overflow,
+            jnp.asarray(ip), jnp.asarray(jp), jnp.asarray(vp),
+            jnp.asarray(maskp))
+        return int(np.asarray(stored).sum())
+
+    def drain(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The compacted entries as host triples; resets the count.
+
+        The spill/test-facing consumer — the megakernel fold reads
+        the device buffers in place instead."""
+        timing.dispatch(1)
+        oi, oj, ov = _drain_jit(self._qi, self._qj, self._qv,
+                                self._count)
+        m = self.count
+        self.reset()
+        return (np.asarray(oi)[:m], np.asarray(oj)[:m],
+                np.asarray(ov)[:m])
+
+    def reset(self, clear_overflow: bool = False) -> None:
+        """Empty the queue (count to zero). The overflow counter is
+        cumulative per run unless explicitly cleared."""
+        self._count = jnp.zeros((), dtype=jnp.int64)
+        if clear_overflow:
+            self._overflow = jnp.zeros((), dtype=jnp.int64)

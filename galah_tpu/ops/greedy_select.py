@@ -182,6 +182,7 @@ def window_select(ani: np.ndarray, ext: np.ndarray,
     validp = np.zeros(b, dtype=bool)
     validp[:w] = True
     timing.dispatch(1)
+    timing.counter("greedy-select-dispatches", 1)
     rep, undecided = _window_select_jit(
         jnp.asarray(mat), jnp.asarray(extp), jnp.asarray(validp),
         jnp.float64(thr))

@@ -1,0 +1,192 @@
+"""Fused device-resident greedy round (the megakernel).
+
+PR 13 overlapped the pipeline's stages but each selection round still
+launched one jitted window fold per round-window and synced through
+host Python in between; PR 14's critical-path blame puts host
+orchestration, not device math, at the top of the e2e wall. This
+module collapses a *slab* of consecutive round windows into one fused
+device program pair:
+
+  1. the slab's surviving screen pairs enqueue into the on-device
+     pair queue (ops/device_queue.py — one pow2-bucketed scatter
+     dispatch, no host materialization of the surviving pair list),
+  2. :func:`_slab_fold_jit` consumes the compacted queue in place: a
+     ``lax.while_loop`` over scatter-max claim propagation applies the
+     same peeling recurrence as ops/greedy_select._window_select_jit,
+     but over the edge LIST instead of a dense per-window matrix — so
+     S windows resolve in 2 dispatches instead of S.
+
+Why a slab is exact: the round machinery is width-invariant (a window
+of S·w genomes decides identically to S sequential w-windows —
+tests/test_greedy_rounds.py::test_rep_rounds_width_invariance pins
+this), and the edge-list recurrence is the matrix recurrence
+restricted to the edges that exist: for column j,
+``any(edges & undecided[:, None], axis=0)`` is exactly a scatter-max
+of ``undecided[qi]`` over the edge endpoints ``qj``. Missing pairs
+(NaN in the matrix) simply have no queue entry; entries whose value
+fails ``v >= thr`` (NaN included — IEEE compares False, like the
+host's ``None`` guard) never pass. The fold iterates until a fixpoint
+(change-detected while_loop, slab-width bound), so whenever both paths
+converge they reach the SAME fixpoint — bit-identical representatives.
+
+Overflow exactness: a slab whose edge count exceeds the queue
+capacity never half-runs — the engine spills the whole slab to the
+existing dense per-window path (counted: megakernel-overflow-spills),
+so clusterings are exact at ANY capacity and the capacity flag is a
+pure performance knob.
+
+Strategy: GALAH_TPU_MEGAKERNEL auto/0/1 (resolve here, enforced in
+cluster/engine.py) — AUTO demotes to the per-window dense fold on any
+runtime failure, an explicit ``1`` pin propagates failures so parity
+runs never compare a fallback to itself (same contract as the overlap
+and greedy-strategy pins).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galah_tpu.obs.profile import profiled
+from galah_tpu.ops.greedy_select import _bucket
+from galah_tpu.utils import timing
+
+jax.config.update("jax_enable_x64", True)
+
+logger = logging.getLogger(__name__)
+
+#: GALAH_TPU_MEGAKERNEL values: auto (engage inside device greedy
+#: rounds, demote on failure), 0 (never), 1 (forced — failures and
+#: ineligibility propagate).
+MEGAKERNEL_MODES = ("auto", "0", "1")
+
+#: Max consecutive round windows fused into one slab. The dispatch
+#: reduction per slab is S windows -> 2 programs (enqueue + fold), so
+#: 16 caps the win at 8x while keeping the conflict-fallback dense
+#: matrix (slab_width^2 f64) small.
+SLAB_WINDOWS = 16
+
+# Numeric-determinism contract checked by `galah-tpu lint` (GL9xx):
+# the fused fold must pick the SAME representatives as the dense
+# window fold and the host scan — it compares stored f64 values with
+# one IEEE >=, never re-accumulates.
+DETERMINISM_CONTRACT = {
+    "family": "megakernel",
+    "dtype": "float64",
+    "functions": ["slab_select", "_slab_fold_jit"],
+}
+
+# Pipeline-discipline annotation (GL10xx): the fused fold is a
+# device-round body — a host-sync call inside it would reintroduce
+# the per-round host round-trip the megakernel removes (GL1006).
+PIPELINE_STAGE = {  # galah-lint: ignore[GL704] the engine owns flow attribution
+    "device_round": ["_slab_fold_jit"],
+}
+
+
+def resolve_megakernel() -> Tuple[str, bool]:
+    """(mode, explicit) for the fused-round strategy.
+
+    Mirrors engine._overlap_mode: malformed values warn and read as
+    AUTO; ``explicit`` is True only for a well-formed pin (the
+    pinned-failure-propagation contract keys off mode == '1')."""
+    env = (os.environ.get("GALAH_TPU_MEGAKERNEL") or "").strip().lower()
+    if env in MEGAKERNEL_MODES:
+        return env, True
+    if env:
+        logger.warning("ignoring malformed GALAH_TPU_MEGAKERNEL=%r "
+                       "(want auto/0/1)", env)
+    return "auto", False
+
+
+@profiled("megakernel.slab_fold")
+@jax.jit
+def _slab_fold_jit(qi: jax.Array, qj: jax.Array, qv: jax.Array,
+                   count: jax.Array, ext: jax.Array, valid: jax.Array,
+                   thr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Queue-fed segmented greedy fold over one slab.
+
+    ``qi``/``qj``/``qv``: the pair queue's buffers — compacted
+    slab-local edge triples with ``qi < qj`` in the dense prefix
+    ``[0, count)``. ``ext``: per-position already-clustered flags from
+    earlier rounds. ``valid``: padding mask. ``thr``: f64 scalar.
+
+    Per iteration, exactly the _window_select_jit recurrence on the
+    edge list: a position becomes a rep when no passing earlier
+    neighbor is still undecided or already a rep, and a member when a
+    passing earlier neighbor IS a rep. The while_loop drains the
+    compacted queue index until no claim changes (fixpoint) or the
+    slab-width depth bound — residual undecided positions signal the
+    caller's conflict fallback, same contract as window_select.
+    """
+    cap = qi.shape[0]
+    width = ext.shape[0]
+    live = jnp.arange(cap) < count
+    passing = live & (qv >= thr)  # NaN False, like the host None guard
+    undecided = valid & ~ext
+    rep = jnp.zeros_like(undecided)
+
+    def cond(carry):
+        it, _rep, _und, changed = carry
+        return changed & (it < width)
+
+    def body(carry):
+        it, rep, und, _ = carry
+        zeros = jnp.zeros(width, dtype=jnp.int32)
+        und_at = zeros.at[qj].max(
+            (passing & und[qi]).astype(jnp.int32)) > 0
+        rep_at = zeros.at[qj].max(
+            (passing & rep[qi]).astype(jnp.int32)) > 0
+        new_rep = und & ~und_at & ~rep_at
+        new_member = und & rep_at
+        und2 = und & ~new_rep & ~new_member
+        return it + 1, rep | new_rep, und2, jnp.any(und2 != und)
+
+    _, rep, undecided, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.zeros((), dtype=jnp.int64), rep, undecided,
+         jnp.ones((), dtype=bool)))
+    return rep, undecided
+
+
+def slab_select(queue, ei: np.ndarray, ej: np.ndarray, ev: np.ndarray,
+                ext: np.ndarray,
+                thr: float) -> Tuple[Optional[np.ndarray], bool]:
+    """One fused slab round: enqueue the slab's edges, fold in place.
+
+    ``queue``: a device_queue.PairQueue. ``ei``/``ej``: slab-local
+    positions with ``ei < ej``; ``ev``: their exact f64 ANIs; ``ext``:
+    already-clustered flags. Returns ``(rep_flags, converged)`` — or
+    ``(None, False)`` when the edges did not fit the queue (capacity
+    spill; the queue is reset and the caller takes the dense path).
+    Two dispatches total regardless of how many round windows the
+    slab fuses.
+    """
+    w = len(ext)
+    n = len(ei)
+    if n > queue.cap:
+        queue.reset()
+        return None, False
+    stored = queue.enqueue(ei, ej, ev)  # 1 dispatch (pow2-bucketed)
+    if stored < n:
+        queue.reset()
+        return None, False
+    gb = _bucket(w)
+    extp = np.zeros(gb, dtype=bool)
+    extp[:w] = ext
+    validp = np.zeros(gb, dtype=bool)
+    validp[:w] = True
+    timing.dispatch(1)
+    timing.counter("greedy-select-dispatches", 1)
+    rep, undecided = _slab_fold_jit(
+        queue._qi, queue._qj, queue._qv, queue._count,
+        jnp.asarray(extp), jnp.asarray(validp), jnp.float64(thr))
+    queue.reset()
+    rep_np = np.asarray(rep)[:w]
+    converged = not bool(np.asarray(undecided)[:w].any())
+    return rep_np, converged

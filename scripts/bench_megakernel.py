@@ -1,0 +1,200 @@
+"""Fused megakernel rounds vs per-window dense folds on the e2e rung.
+
+The megakernel (ops/device_queue.py + ops/megakernel.py) collapses a
+slab of consecutive greedy round windows into two device programs —
+one pow2-bucketed enqueue of the slab's surviving pairs and one fused
+fold — in place of one dense window fold per window plus the host
+round-trips between them. This stage prices exactly that on the bench
+ladder's e2e rung workload (planted families, 3% mutation, 100 kbp),
+end to end through ``generate_galah_clusterer(...).cluster()``:
+
+  * megakernel: GALAH_TPU_MEGAKERNEL=1 (pinned — a fused-fold failure
+    must fail the stage, not silently price the dense fallback), run
+    FIRST so its jit compiles land inside its own timing;
+  * off: GALAH_TPU_MEGAKERNEL=0, the per-window dense-fold baseline;
+  * both: GALAH_TPU_OVERLAP=1 + the xla/device twin pins of
+    bench_overlap.py, rep-rounds=16 so a full slab fuses
+    SLAB_WINDOWS(16) windows and the dispatch win is measurable.
+
+Verdict numbers:
+
+  * ``parity`` — identical clusterings (a failure zeroes the speedup:
+    the megakernel is a scheduling change, not an algorithm change);
+  * ``dispatch_ratio`` — greedy-select dispatches per run, off/mega;
+    the acceptance gate is >= 4x (``dispatch_gate``);
+  * ``host_share`` / ``host_blame_s`` — the critical path's host-vs-
+    device blame decomposition for the megakernel run
+    (obs/flow.critical_path), THE headline gauge: the megakernel
+    exists to drive host orchestration share down (<10% on the
+    1000-genome rung once device math dominates; on a 1-core CPU host
+    both sides share one core, so read it with `host_cores`).
+
+Self-budgeting like the variant matrices: under a tight --budget the
+workload downshifts to a 200-genome rung (recorded in `workload`), and
+a partial run still prints MEGAKERNEL_JSON with what it measured.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_T0 = time.monotonic()
+
+# Megakernel bookkeeping copied into the payload (deltas across the
+# timed megakernel run).
+_COUNTERS = ("megakernel-slab-folds", "megakernel-overflow-spills",
+             "megakernel-demoted", "greedy-select-dispatches",
+             "greedy-rounds", "overlap-eager-rounds",
+             "greedy-host-fallback-windows")
+
+_VALUES = {"ani": 95.0, "precluster_ani": 90.0,
+           "min_aligned_fraction": 15.0, "fragment_length": 3000,
+           "precluster_method": "finch", "cluster_method": "skani",
+           "threads": 1, "rep_rounds": 16}
+
+# Pinned for BOTH runs — the comparison isolates the megakernel, so
+# everything else (sketcher, greedy strategy, overlap) stays a twin.
+_PINS = {"GALAH_TPU_SKETCH_STRATEGY": "xla",
+         "GALAH_TPU_GREEDY_STRATEGY": "device",
+         "GALAH_TPU_OVERLAP": "1",
+         # a 16-window slab of 16-genome windows inside a 100-genome
+         # family carries ~13k materialized edges; the default 4096
+         # cap would spill every slab and price the dense path
+         "GALAH_TPU_QUEUE_CAP": "16384"}
+
+
+def _left(budget):
+    return budget - (time.monotonic() - _T0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=None,
+                    help="seconds for the whole stage (default 570, "
+                         "capped by GALAH_BENCH_STAGE_CAP)")
+    args = ap.parse_args()
+
+    budget = args.budget if args.budget is not None else 570.0
+    cap = os.environ.get("GALAH_BENCH_STAGE_CAP")
+    if cap:
+        budget = min(budget, float(cap))
+
+    from bench import _synth_families
+    from galah_tpu.api import generate_galah_clusterer
+    from galah_tpu.obs import flow as obs_flow
+    from galah_tpu.utils import timing
+
+    # x100 families, NOT the ladder's x4: greedy rounds only engage
+    # for preclusters past DENSE_PRECLUSTER_CAP(64) members, so the
+    # fused-round comparison needs big preclusters to have rounds to
+    # fuse at all (x4 families all take the dense per-precluster path
+    # and both sides would measure an empty loop).
+    if _left(budget) >= 240:
+        n_genomes, n_families = 1000, 10
+    else:
+        n_genomes, n_families = 200, 2
+    paths = _synth_families(n_genomes=n_genomes, genome_len=100_000,
+                            n_families=n_families, mut=0.03, seed=11)
+
+    try:
+        host_cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        host_cores = os.cpu_count() or 1
+
+    out = {
+        "workload": f"{n_genomes} synthetic genomes, {n_families} "
+                    "planted families x100, 3% mutation, 100 kbp, "
+                    "murmur3 finch+skani, xla sketcher, overlapped, "
+                    "rep-rounds=16",
+        "n_genomes": n_genomes,
+        # On a 1-core host the host and the 'device' share the same
+        # core, so host_share measures orchestration fraction, not a
+        # transferable wall-clock win — readers must interpret
+        # `speedup` and `host_share` against this field.
+        "host_cores": host_cores,
+        "skipped": [],
+    }
+    clusterings = {}
+    dispatches = {}
+
+    def run_one(mode):
+        env_saved = {k: os.environ.get(k)
+                     for k in ("GALAH_TPU_MEGAKERNEL", *_PINS)}
+        os.environ["GALAH_TPU_MEGAKERNEL"] = \
+            "1" if mode == "mega" else "0"
+        os.environ.update(_PINS)
+        obs_flow.reset()  # per-run flow graph
+        try:
+            before = timing.GLOBAL.counters()
+            t0 = time.perf_counter()
+            clusterer = generate_galah_clusterer(list(paths),
+                                                 dict(_VALUES))
+            clusters = clusterer.cluster()
+            dt = time.perf_counter() - t0
+            after = timing.GLOBAL.counters()
+        finally:
+            for k, v in env_saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        clusterings[mode] = clusters
+        dispatches[mode] = (after.get("greedy-select-dispatches", 0)
+                            - before.get("greedy-select-dispatches", 0))
+        out[f"{mode}_genomes_per_sec"] = round(len(paths) / dt, 2)
+        out[f"{mode}_seconds"] = round(dt, 3)
+        out[f"{mode}_n_clusters"] = len(clusters)
+        if mode == "mega":
+            out["counters"] = {
+                k: after.get(k, 0) - before.get(k, 0)
+                for k in _COUNTERS
+                if after.get(k, 0) - before.get(k, 0)}
+            # the headline gauge: host-vs-device blame over the wall
+            fsnap = obs_flow.snapshot()
+            if fsnap.get("stages"):
+                cp = obs_flow.critical_path(fsnap, dt)
+                host = cp.get("host") or {}
+                if isinstance(host.get("share"), (int, float)):
+                    out["host_share"] = host["share"]
+                    out["host_blame_s"] = host.get("blame_s")
+                    out["host_share_gate"] = host["share"] < 0.10
+                out["bottleneck"] = cp.get("bottleneck")
+
+    # Megakernel first: its compiles are billed to it.
+    for mode in ("mega", "off"):
+        if _left(budget) < 30:
+            out["skipped"].append(mode)
+            continue
+        try:
+            run_one(mode)
+        except Exception as e:  # noqa: BLE001 - partial JSON > crash
+            out[f"{mode}_error"] = f"{type(e).__name__}: {e}"
+
+    if "mega" in clusterings and "off" in clusterings:
+        out["parity"] = clusterings["mega"] == clusterings["off"]
+        if out["parity"] and out.get("off_genomes_per_sec"):
+            out["speedup"] = round(out["mega_genomes_per_sec"]
+                                   / out["off_genomes_per_sec"], 2)
+            if host_cores <= 1:
+                out["speedup_note"] = (
+                    "1-core host: device programs and host "
+                    "orchestration share one core, so speedup ~1x is "
+                    "the expected ceiling (dispatch_ratio and parity "
+                    "are the verdicts here, not the rate)")
+        elif not out["parity"]:
+            out["speedup"] = 0.0
+        if dispatches.get("mega"):
+            out["dispatch_ratio"] = round(
+                dispatches["off"] / dispatches["mega"], 2)
+            out["dispatch_gate"] = out["dispatch_ratio"] >= 4.0
+
+    print("MEGAKERNEL_JSON " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -116,7 +116,11 @@ def cluster_argv(genomes: List[str], out_tsv: str, ckpt: str,
 #: is safe on every launch in the kill/resume chain.
 OVERLAP_ENV = {"GALAH_TPU_OVERLAP": "1",
                "GALAH_TPU_SKETCH_STRATEGY": "xla",
-               "GALAH_TPU_GREEDY_STRATEGY": "device"}
+               "GALAH_TPU_GREEDY_STRATEGY": "device",
+               # pinned, not auto: a fused-fold failure must fail the
+               # iteration loudly instead of demoting to the dense
+               # path and quietly passing the byte-identity gate
+               "GALAH_TPU_MEGAKERNEL": "1"}
 
 
 def index_argv(index_dir: str, genomes: Optional[List[str]] = None,

@@ -178,6 +178,14 @@ run_stage engine_rounds 900 python -u scripts/bench_engine_rounds.py \
 # and lands in its own artifact).
 run_stage e2e_overlap 900 python -u scripts/bench_overlap.py \
   --budget 840
+# Fused megakernel rounds vs per-window dense folds on the same rung:
+# cluster-parity gate, the off/mega greedy-select dispatch ratio
+# (acceptance: >= 4x), and the critical path's host-blame share — the
+# gauge the fused rounds exist to drive down (<10% target once device
+# math dominates; read against host_cores on CPU hosts). Also runs
+# inside bench.py; the dedicated stage survives a bench.py wedge.
+run_stage megakernel 900 python -u scripts/bench_megakernel.py \
+  --budget 840
 # 1-D vs 2D tiled mesh all-pairs scaling (N in {1k, 5k, 20k}):
 # candidate pairs/s for both geometries, the modeled per-row DCN
 # bytes and their ratio (the communication-avoiding claim), and the

@@ -1,0 +1,23 @@
+"""GL1006 fixture: host syncs inside a declared device-round body."""
+
+import jax
+import numpy as np
+
+# GL1005: "phantom_fold" is not defined in this module.
+PIPELINE_STAGE = {
+    "device_round": ["_fold_body", "phantom_fold"],
+}
+
+
+def _fold_body(qi, qj, qv, count):
+    arr = np.asarray(qv)                # GL1006 (forces a transfer)
+    n = count.item()                    # GL1006 (scalar pull)
+    pulled = jax.device_get(qi)         # GL1006
+    jax.block_until_ready(qj)           # GL1006
+    return arr, n, pulled
+
+
+def host_wrapper(qv):
+    # Unannotated: conversions at the wrapper boundary are the fix,
+    # so the very same calls stay silent here.
+    return np.asarray(qv), jax.device_get(qv)

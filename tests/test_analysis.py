@@ -768,6 +768,47 @@ def test_gl1004_accepts_constant_literal_and_helper_emission():
     assert codes(check_pipeline_file(src)) == ["GL1004"]
 
 
+def test_bad_megakernel_fires_gl1006_on_every_sync_idiom():
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    src = load_fixture("bad_megakernel.py",
+                       path="galah_tpu/ops/bad_megakernel.py")
+    found = check_pipeline_file(src)
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, []).append(f.line)
+    # np.asarray, .item(), jax.device_get, jax.block_until_ready —
+    # one finding per sync call inside the annotated fold body
+    assert sorted(by_code["GL1006"]) == [13, 14, 15, 16]
+    # dangling device_round name "phantom_fold" (anchored at the
+    # annotation)
+    assert by_code["GL1005"] == [7]
+    # the identical calls in the unannotated host_wrapper stay silent
+    assert sorted(by_code) == ["GL1005", "GL1006"]
+    assert all(f.severity is Severity.WARNING for f in found)
+    assert all(f.symbol == "_fold_body"
+               for f in found if f.code == "GL1006")
+
+
+def test_gl1006_device_round_annotation_validation():
+    import ast
+
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    # non-list device_round value is a GL1005, not a crash
+    text = ('PIPELINE_STAGE = {"device_round": "fold"}\n'
+            "def fold():\n    return 1\n")
+    src = SourceFile(path="galah_tpu/ops/x.py", text=text,
+                     tree=ast.parse(text))
+    assert codes(check_pipeline_file(src)) == ["GL1005"]
+    # a sync-free annotated body is silent
+    text = ('PIPELINE_STAGE = {"device_round": ["fold"]}\n'
+            "def fold(x):\n    return x + 1\n")
+    src = SourceFile(path="galah_tpu/ops/x.py", text=text,
+                     tree=ast.parse(text))
+    assert check_pipeline_file(src) == []
+
+
 def test_gl10xx_family_and_suppression():
     from galah_tpu.analysis.core import family_of
 

@@ -380,6 +380,12 @@ def test_flow_metrics_feed_the_perf_ledger(tmp_path):
     metrics = ledger_mod.metrics_of_report(rep)
     assert "flow.sketch.blame_s" in metrics
     assert "flow.pairs.share" in metrics
+    # Per-stage blame partitions the wall clock exactly. flow.host.* is
+    # a cross-cutting decomposition of the same blame (host vs device),
+    # not an extra stage, so it stays out of the partition sum.
     total = sum(v for k, v in metrics.items()
-                if k.startswith("flow.") and k.endswith(".blame_s"))
+                if k.startswith("flow.") and k.endswith(".blame_s")
+                and not k.startswith("flow.host."))
     assert total == pytest.approx(rep["run"]["duration_s"], rel=1e-6)
+    assert 0.0 <= metrics["flow.host.share"] <= 1.0
+    assert ledger_mod.metric_direction("flow.host.share") == "lower"

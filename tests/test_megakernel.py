@@ -1,0 +1,462 @@
+"""Device-resident megakernel (ops/device_queue.py + ops/megakernel.py).
+
+The contract under test: with GALAH_TPU_MEGAKERNEL engaged, a slab of
+consecutive greedy round windows resolves through the on-device pair
+queue and ONE fused fold program — and the clustering is BIT-IDENTICAL
+to the per-window dense fold on every workload, at every queue
+capacity (overflow spills to the exact dense path, never half-runs).
+These tests pin the queue invariants (compaction, bounded exact
+overflow, pow2 bucketing), the fused fold's decision parity with
+window_select, the auto/0/1 engagement-and-demotion matrix, the
+dispatch-count win, and round-granular crash resume under the pin.
+"""
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pytest
+
+from galah_tpu.backends.base import ClusterBackend, PreclusterBackend
+from galah_tpu.cluster import cluster
+from galah_tpu.cluster.cache import PairDistanceCache
+from galah_tpu.cluster.checkpoint import ClusterCheckpoint, run_fingerprint
+from galah_tpu.ops import device_queue, megakernel
+from galah_tpu.utils import timing
+
+
+class TablePre(PreclusterBackend):
+    def __init__(self, pairs):
+        self.pairs = pairs
+
+    def method_name(self):
+        return "stub-pre"
+
+    def distances(self, genome_paths):
+        cache = PairDistanceCache()
+        for (i, j), ani in self.pairs.items():
+            cache.insert((i, j), ani)
+        return cache
+
+
+class StreamTablePre(TablePre):
+    """Blockwise streamed pair pass (same contract as
+    tests/test_overlap.py::StreamTablePre)."""
+
+    def __init__(self, pairs, n, block=7):
+        super().__init__(pairs)
+        self.n = n
+        self.block = block
+
+    def distances_streamed(self, genome_paths):
+        by_row = {}
+        for (i, j), ani in self.pairs.items():
+            by_row.setdefault(max(i, j), {})[(i, j)] = ani
+
+        def gen():
+            r1 = 0
+            while r1 < self.n:
+                r0, r1 = r1, min(r1 + self.block, self.n)
+                inc = {}
+                for r in range(r0, r1):
+                    inc.update(by_row.get(r, {}))
+                yield r1, inc
+
+        return gen()
+
+
+class TableCl(ClusterBackend):
+    def __init__(self, table, threshold, fail_on_call=None):
+        self.table = {frozenset(k): v for k, v in table.items()}
+        self.threshold = threshold
+        self.calls: List[list] = []
+        self.pairs_computed: List[tuple] = []
+        self.fail_on_call = fail_on_call
+
+    def method_name(self):
+        return "stub-exact"
+
+    @property
+    def ani_threshold(self):
+        return self.threshold
+
+    def calculate_ani_batch(
+            self, pairs: Sequence[tuple]) -> List[Optional[float]]:
+        self.calls.append(list(pairs))
+        if (self.fail_on_call is not None
+                and len(self.calls) >= self.fail_on_call):
+            raise RuntimeError("injected backend failure")
+        self.pairs_computed.extend(pairs)
+        return [self.table.get(frozenset(p)) for p in pairs]
+
+
+def g(n):
+    return [f"g{i}.fna" for i in range(n)]
+
+
+def _family_workload(n_families, fam_size, seed, none_rate=0.05,
+                     thr=0.95):
+    rng = np.random.default_rng(seed)
+    pre, table = {}, {}
+    for f in range(n_families):
+        base = f * fam_size
+        for a in range(fam_size):
+            for b in range(a + 1, fam_size):
+                i, j = base + a, base + b
+                pre[(i, j)] = 0.96
+                if rng.random() < none_rate:
+                    table[(f"g{i}.fna", f"g{j}.fna")] = None
+                else:
+                    table[(f"g{i}.fna", f"g{j}.fna")] = round(
+                        float(rng.uniform(thr - 0.05, thr + 0.04)), 6)
+    return pre, table
+
+
+def _reference(monkeypatch, n, pre, table, thr=0.95, **kw):
+    """The independent baseline: stage-serial device rounds, no mega."""
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "0")
+    monkeypatch.setenv("GALAH_TPU_MEGAKERNEL", "0")
+    return cluster(g(n), TablePre(pre), TableCl(table, thr), **kw)
+
+
+def _overlapped(monkeypatch, n, pre, table, mega, thr=0.95, block=7,
+                cap=None, cl=None, **kw):
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "1")
+    monkeypatch.setenv("GALAH_TPU_MEGAKERNEL", mega)
+    if cap is not None:
+        monkeypatch.setenv("GALAH_TPU_QUEUE_CAP", str(cap))
+    return cluster(g(n), StreamTablePre(pre, n, block=block),
+                   cl or TableCl(table, thr), **kw)
+
+
+def _counter(name):
+    return timing.GLOBAL.counters().get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# PairQueue unit lattice
+# ---------------------------------------------------------------------------
+
+
+def test_queue_enqueue_drain_roundtrip():
+    q = device_queue.PairQueue(cap=32)
+    i = np.array([0, 1, 2], dtype=np.int32)
+    j = np.array([3, 4, 5], dtype=np.int32)
+    v = np.array([0.97, 0.91, 0.955], dtype=np.float64)
+    assert q.enqueue(i, j, v) == 3
+    assert q.count == 3 and q.overflow == 0
+    oi, oj, ov = q.drain()
+    np.testing.assert_array_equal(oi, i)
+    np.testing.assert_array_equal(oj, j)
+    # stored verbatim: the exact IEEE bits, no transform
+    np.testing.assert_array_equal(ov, v)
+    assert q.count == 0  # drain resets
+
+
+def test_queue_batches_compact_to_dense_prefix():
+    q = device_queue.PairQueue(cap=16)
+    q.enqueue(np.array([0, 1]), np.array([2, 3]),
+              np.array([0.9, 0.91]))
+    q.enqueue(np.array([4, 5, 6]), np.array([7, 8, 9]),
+              np.array([0.92, 0.93, 0.94]))
+    assert q.count == 5
+    oi, oj, ov = q.drain()
+    np.testing.assert_array_equal(oi, [0, 1, 4, 5, 6])
+    np.testing.assert_array_equal(oj, [2, 3, 7, 8, 9])
+    np.testing.assert_array_equal(ov, [0.9, 0.91, 0.92, 0.93, 0.94])
+
+
+def test_queue_overflow_is_bounded_and_exact():
+    q = device_queue.PairQueue(cap=8)
+    assert q.cap == 8
+    i = np.arange(12, dtype=np.int32)
+    stored = q.enqueue(i, i + 100, i.astype(np.float64) / 100.0)
+    # the prefix that fits is stored, the rest counted — never dropped
+    # silently
+    assert stored == 8
+    assert q.count == 8 and q.overflow == 4
+    oi, _, ov = q.drain()
+    np.testing.assert_array_equal(oi, np.arange(8))
+    np.testing.assert_array_equal(ov, np.arange(8) / 100.0)
+    # overflow is cumulative per run; reset(clear_overflow) zeroes it
+    assert q.overflow == 4
+    q.reset(clear_overflow=True)
+    assert q.overflow == 0
+    # the queue is reusable after overflow + reset
+    assert q.enqueue(np.array([1]), np.array([2]),
+                     np.array([0.99])) == 1
+    assert q.count == 1 and q.overflow == 0
+
+
+def test_queue_empty_drain_and_pow2_cap():
+    q = device_queue.PairQueue(cap=5)
+    assert q.cap == 8  # pow2-rounded, floor _MIN_CAP
+    oi, oj, ov = q.drain()
+    assert len(oi) == len(oj) == len(ov) == 0
+    assert q.enqueue(np.array([], dtype=np.int32),
+                     np.array([], dtype=np.int32),
+                     np.array([], dtype=np.float64)) == 0
+
+
+def test_resolve_queue_cap_parsing(monkeypatch):
+    monkeypatch.delenv("GALAH_TPU_QUEUE_CAP", raising=False)
+    assert device_queue.resolve_queue_cap() == 4096
+    monkeypatch.setenv("GALAH_TPU_QUEUE_CAP", "1000")
+    assert device_queue.resolve_queue_cap() == 1024  # pow2-rounded
+    monkeypatch.setenv("GALAH_TPU_QUEUE_CAP", "8")
+    assert device_queue.resolve_queue_cap() == 8
+    monkeypatch.setenv("GALAH_TPU_QUEUE_CAP", "3")
+    assert device_queue.resolve_queue_cap() == 8  # floor
+    for bad in ("0", "-16", "many"):
+        monkeypatch.setenv("GALAH_TPU_QUEUE_CAP", bad)
+        assert device_queue.resolve_queue_cap() == 4096
+
+
+# ---------------------------------------------------------------------------
+# Fused slab fold: unit parity with the dense window fold
+# ---------------------------------------------------------------------------
+
+
+def test_slab_fold_matches_window_select_randomized():
+    """The edge-list recurrence IS the matrix recurrence restricted to
+    existing edges: same reps, same convergence flag, over random
+    sparse windows with NaN-gated pairs and pre-clustered positions."""
+    from galah_tpu.ops.greedy_select import window_select
+
+    q = device_queue.PairQueue(cap=1024)
+    for seed in range(25):
+        rng = np.random.default_rng(1000 + seed)
+        w = int(rng.integers(2, 40))
+        mat = np.full((w, w), np.nan, dtype=np.float64)
+        ei, ej, ev = [], [], []
+        for a in range(w):
+            for b in range(a + 1, w):
+                if rng.random() < 0.4:
+                    val = float(rng.uniform(0.9, 0.99))
+                    mat[a, b] = val
+                    ei.append(a)
+                    ej.append(b)
+                    ev.append(val)
+        ext = rng.random(w) < 0.2
+        dense_rep, dense_conv = window_select(mat, ext, 0.95)
+        rep, conv = megakernel.slab_select(
+            q, np.asarray(ei, dtype=np.int32),
+            np.asarray(ej, dtype=np.int32),
+            np.asarray(ev, dtype=np.float64), ext, 0.95)
+        assert conv == dense_conv, f"seed {seed}"
+        np.testing.assert_array_equal(rep, dense_rep,
+                                      err_msg=f"seed {seed}")
+        assert q.count == 0  # fold leaves the queue reset
+
+
+def test_slab_select_spills_on_capacity():
+    q = device_queue.PairQueue(cap=8)
+    n = 12
+    ei, ej = np.triu_indices(6, k=1)  # 15 edges > cap
+    ev = np.full(len(ei), 0.97)
+    rep, conv = megakernel.slab_select(
+        q, ei.astype(np.int32)[:n], ej.astype(np.int32)[:n],
+        ev[:n], np.zeros(6, dtype=bool), 0.95)
+    assert rep is None and conv is False
+    assert q.count == 0  # spill leaves the queue clean for reuse
+
+
+def test_resolve_megakernel_modes(monkeypatch):
+    monkeypatch.delenv("GALAH_TPU_MEGAKERNEL", raising=False)
+    assert megakernel.resolve_megakernel() == ("auto", False)
+    for mode in ("auto", "0", "1"):
+        monkeypatch.setenv("GALAH_TPU_MEGAKERNEL", mode)
+        assert megakernel.resolve_megakernel() == (mode, True)
+    monkeypatch.setenv("GALAH_TPU_MEGAKERNEL", "always")
+    assert megakernel.resolve_megakernel() == ("auto", False)
+
+
+def test_megakernel_flags_registered():
+    from galah_tpu import config
+
+    mk = config.FLAGS["GALAH_TPU_MEGAKERNEL"]
+    assert mk.default == "auto"
+    assert set(mk.choices) == {"auto", "0", "1"}
+    assert "GALAH_TPU_QUEUE_CAP" in config.FLAGS
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: end-to-end clusterings
+# ---------------------------------------------------------------------------
+
+
+def test_megakernel_planted_families_1000_parity(monkeypatch):
+    """Golden-cluster equality on the 1000-genome rung shape, and the
+    slab-fold counter proves the fused path actually ran."""
+    pre, table = _family_workload(250, 4, seed=11)
+    ref = _reference(monkeypatch, 1000, pre, table)
+    before = _counter("megakernel-slab-folds")
+    out = _overlapped(monkeypatch, 1000, pre, table, mega="auto",
+                      block=64)
+    assert out == ref
+    assert _counter("megakernel-slab-folds") > before
+
+
+def test_megakernel_dense_96_parity(monkeypatch):
+    """The mega-family worst case: ONE precluster, every pair
+    materialized — 4560 edges need an explicit capacity raise, and the
+    decisions must still match exactly."""
+    rng = np.random.default_rng(3)
+    n = 96
+    pre, table = {}, {}
+    for i in range(n):
+        for j in range(i + 1, n):
+            pre[(i, j)] = 0.96
+            table[(f"g{i}.fna", f"g{j}.fna")] = round(
+                float(rng.uniform(0.90, 0.99)), 6)
+    ref = _reference(monkeypatch, n, pre, table)
+    out = _overlapped(monkeypatch, n, pre, table, mega="auto",
+                      block=16, cap=8192, rep_rounds=16)
+    assert out == ref
+
+
+def test_megakernel_capacity_block_width_sweep(monkeypatch):
+    """Exactness at ANY capacity: a queue too small for a slab spills
+    that slab to the dense path, so every (cap, block, width) cell
+    yields the reference clustering. cap=8 forces spills and the
+    counter proves the spill path ran."""
+    pre, table = _family_workload(12, 4, seed=21)
+    n = 48
+    ref = _reference(monkeypatch, n, pre, table)
+    for cap in (8, 256, 4096):
+        for block in (5, 48):
+            for width in (4, 16):
+                out = _overlapped(monkeypatch, n, pre, table,
+                                  mega="auto", block=block, cap=cap,
+                                  rep_rounds=width)
+                assert out == ref, \
+                    f"cap={cap} block={block} rep_rounds={width}"
+    before = _counter("megakernel-overflow-spills")
+    out = _overlapped(monkeypatch, n, pre, table, mega="auto",
+                      block=48, cap=8, rep_rounds=16)
+    assert out == ref
+    assert _counter("megakernel-overflow-spills") > before
+
+
+def test_megakernel_dispatch_reduction_at_least_4x(monkeypatch):
+    """The acceptance ratio: fused slabs cut greedy-select dispatches
+    (enqueue + fold per slab vs one fold per window) by >= 4x on the
+    rung shape at full slab fusion."""
+    pre, table = _family_workload(64, 4, seed=33)
+    n = 256
+    ref = _reference(monkeypatch, n, pre, table)
+    b0 = _counter("greedy-select-dispatches")
+    out_off = _overlapped(monkeypatch, n, pre, table, mega="0",
+                          block=n, rep_rounds=4)
+    d_off = _counter("greedy-select-dispatches") - b0
+    b1 = _counter("greedy-select-dispatches")
+    out_on = _overlapped(monkeypatch, n, pre, table, mega="auto",
+                         block=n, rep_rounds=4)
+    d_on = _counter("greedy-select-dispatches") - b1
+    assert out_off == ref and out_on == ref
+    assert d_on > 0
+    assert d_off / d_on >= 4, (d_off, d_on)
+
+
+# ---------------------------------------------------------------------------
+# auto / 0 / 1: engagement, demotion, pinned-failure propagation
+# ---------------------------------------------------------------------------
+
+
+def test_megakernel_off_never_folds(monkeypatch):
+    pre, table = _family_workload(8, 4, seed=2)
+    ref = _reference(monkeypatch, 32, pre, table)
+    before = _counter("megakernel-slab-folds")
+    out = _overlapped(monkeypatch, 32, pre, table, mega="0")
+    assert out == ref
+    assert _counter("megakernel-slab-folds") == before
+
+
+def test_megakernel_pin_requires_device_strategy(monkeypatch):
+    pre, table = _family_workload(4, 4, seed=2)
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "host")
+    monkeypatch.setenv("GALAH_TPU_MEGAKERNEL", "1")
+    with pytest.raises(RuntimeError, match="device greedy strategy"):
+        cluster(g(16), TablePre(pre), TableCl(table, 0.95))
+
+
+def test_megakernel_auto_demotes_on_failure(monkeypatch):
+    """AUTO: a runtime failure inside the fused fold demotes to the
+    per-window dense path for the run — counted, event-logged, and
+    still the exact clustering."""
+    pre, table = _family_workload(8, 4, seed=6)
+    ref = _reference(monkeypatch, 32, pre, table)
+
+    def boom(*a, **k):
+        raise ValueError("injected fold failure")
+
+    monkeypatch.setattr(megakernel, "slab_select", boom)
+    before = _counter("megakernel-demoted")
+    out = _overlapped(monkeypatch, 32, pre, table, mega="auto")
+    assert out == ref
+    assert _counter("megakernel-demoted") == before + 1
+
+
+def test_megakernel_pin_propagates_failure(monkeypatch):
+    """GALAH_TPU_MEGAKERNEL=1: the same injected failure must
+    propagate, never demote — parity runs must not compare a silent
+    fallback to itself."""
+    pre, table = _family_workload(8, 4, seed=6)
+
+    def boom(*a, **k):
+        raise ValueError("injected fold failure")
+
+    monkeypatch.setattr(megakernel, "slab_select", boom)
+    monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+    monkeypatch.setenv("GALAH_TPU_OVERLAP", "1")
+    monkeypatch.setenv("GALAH_TPU_MEGAKERNEL", "1")
+    with pytest.raises(ValueError, match="injected fold failure"):
+        cluster(g(32), StreamTablePre(pre, 32),
+                TableCl(table, 0.95))
+
+
+# ---------------------------------------------------------------------------
+# Crash resume under the pin
+# ---------------------------------------------------------------------------
+
+
+def test_megakernel_pinned_crash_resume_parity(monkeypatch, tmp_path):
+    """Round-granular resume with the megakernel pinned in the
+    stage-serial engine: a run that dies mid-selection resumes from
+    greedy_rounds.jsonl and finishes with the uninterrupted
+    clustering — slab fusion changes the round cadence, not the
+    durable-replay contract."""
+    pre, table = _family_workload(10, 4, seed=9, none_rate=0.0)
+    n = 40
+
+    def _pin():
+        monkeypatch.setenv("GALAH_TPU_GREEDY_STRATEGY", "device")
+        monkeypatch.setenv("GALAH_TPU_OVERLAP", "0")
+        monkeypatch.setenv("GALAH_TPU_MEGAKERNEL", "1")
+        # a small queue keeps slabs narrow => several rounds to replay
+        monkeypatch.setenv("GALAH_TPU_QUEUE_CAP", "16")
+
+    ref = _reference(monkeypatch, n, pre, table, rep_rounds=4)
+    _pin()
+    full_cl = TableCl(table, 0.95)
+    assert cluster(g(n), TablePre(pre), full_cl, rep_rounds=4) == ref
+    n_calls = len(full_cl.calls)
+    assert n_calls >= 2  # need a mid-run crash point
+
+    fp = run_fingerprint(g(n), "stub-pre", "stub-exact", 0.95, 0.9)
+    ck1 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    cl1 = TableCl(table, 0.95, fail_on_call=max(2, n_calls // 2))
+    with pytest.raises(RuntimeError, match="injected backend failure"):
+        cluster(g(n), TablePre(pre), cl1, checkpoint=ck1, rep_rounds=4)
+    assert (tmp_path / "ck" / "greedy_rounds.jsonl").exists()
+
+    before = _counter("greedy-replayed-pairs")
+    ck2 = ClusterCheckpoint(str(tmp_path / "ck"), fp)
+    cl2 = TableCl(table, 0.95)
+    out = cluster(g(n), TablePre(pre), cl2, checkpoint=ck2,
+                  rep_rounds=4)
+    assert out == ref
+    assert _counter("greedy-replayed-pairs") > before
+    # a finished run clears the round log
+    assert not (tmp_path / "ck" / "greedy_rounds.jsonl").exists()

@@ -102,6 +102,16 @@ def test_metric_direction_families():
         "profile.hbm_peak_bytes") == "lower"
     assert ledger.metric_direction("bench.errors") == "lower"
     assert ledger.metric_direction("funnel.kept") == "neutral"
+    # exact-name overrides: host-blame share is the megakernel's
+    # headline gauge — "share" matches no substring family, but host
+    # orchestration migrating back up is a regression
+    assert ledger.metric_direction("flow.host.share") == "lower"
+    assert ledger.metric_direction("flow.host.blame_s") == "lower"
+    assert ledger.metric_direction(
+        "bench.megakernel_host_share") == "lower"
+    # the per-stage blame shares stay neutral (blame moving between
+    # stages is drift to look at, not a regression by itself)
+    assert ledger.metric_direction("flow.pairs.share") == "neutral"
 
 
 # -- check(): verdict taxonomy ---------------------------------------
