@@ -22,6 +22,17 @@ Checker families
          unbounded queues/pools, missing occupancy-gauge emission);
          the runtime complement is the GalahSan sanitizer
          (galah_tpu/analysis/sanitizer.py, GALAH_SAN=1)
+  GL11xx interprocedural effect auditors over GalahIR (analysis/ir.py):
+         the whole-program call graph with per-function inferred
+         effect sets propagated to fixpoint, so the contracts above
+         hold through helper indirection too — transitive host sync
+         from a device-round body (GL1101), durable writes around
+         io/atomic.py (GL1102), transitive stream materialization
+         (GL1103), lock leaks on raising paths (GL1104), effectful
+         pool callbacks without stage-token adoption (GL1105).
+         Per-file IR is content-hash cached (--ir-cache-dir /
+         GALAH_TPU_IR_CACHE), as is the GL5xx shapes verdict, so a
+         warm lint run costs a fraction of a cold one.
 
 Suppression: ``# galah-lint: ignore[GL103]`` on the flagged line or
 the line above (optionally ``... expires=YYYY-MM-DD``; past the date
@@ -44,7 +55,7 @@ from galah_tpu.analysis.core import Finding, Severity, SourceFile
 
 CHECK_NAMES = ("pallas", "runtime", "flags", "markers", "shapes",
                "obs", "concurrency", "fs", "determinism", "pipeline",
-               "suppressions")
+               "effects", "suppressions")
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
                                 "baseline.json")
 
@@ -67,64 +78,85 @@ def load_sources(root: str) -> Dict[str, SourceFile]:
 
 
 def run_checks(sources: Dict[str, SourceFile],
-               checks: Sequence[str] = CHECK_NAMES) -> List[Finding]:
+               checks: Sequence[str] = CHECK_NAMES,
+               ir_cache_dir: Optional[str] = None,
+               timings: Optional[Dict[str, float]] = None
+               ) -> List[Finding]:
     """All requested checkers over the loaded tree (no suppression
-    applied yet)."""
+    applied yet). The parse is shared: every family reads the same
+    ``SourceFile`` objects (one read + one ``ast.parse`` per file per
+    invocation, memoized node lists via ``SourceFile.walk``).
+
+    ``ir_cache_dir`` enables the content-hash IR/verdict cache for the
+    effects and shapes families; ``timings``, when passed, is filled
+    with per-family wall seconds."""
     findings: List[Finding] = []
+
+    def timed(name: str, produce) -> None:
+        t0 = time.monotonic()
+        findings.extend(produce())
+        if timings is not None:
+            timings[name] = time.monotonic() - t0
+
+    def per_file(check_file):
+        return lambda: [f for src in sources.values()
+                        for f in check_file(src)]
+
     if "pallas" in checks:
         from galah_tpu.analysis.pallas_check import check_pallas_file
-        for src in sources.values():
-            findings.extend(check_pallas_file(src))
+        timed("pallas", per_file(check_pallas_file))
     if "runtime" in checks:
         from galah_tpu.analysis.runtime_checks import check_runtime_file
-        for src in sources.values():
-            findings.extend(check_runtime_file(src))
+        timed("runtime", per_file(check_runtime_file))
     if "flags" in checks:
         from galah_tpu.analysis.flags_check import check_flag_references
-        findings.extend(check_flag_references(list(sources.values())))
+        timed("flags",
+              lambda: check_flag_references(list(sources.values())))
     if "markers" in checks:
         from galah_tpu.analysis.markers_check import check_markers_file
-        for src in sources.values():
-            findings.extend(check_markers_file(src))
+        timed("markers", per_file(check_markers_file))
     if "shapes" in checks:
         from galah_tpu.analysis.shapes import check_shape_contracts
-        findings.extend(check_shape_contracts())
+        timed("shapes",
+              lambda: check_shape_contracts(cache_dir=ir_cache_dir))
     if "obs" in checks:
         from galah_tpu.analysis.obs_check import check_obs_file
-        for src in sources.values():
-            findings.extend(check_obs_file(src))
+        timed("obs", per_file(check_obs_file))
     if "concurrency" in checks:
         from galah_tpu.analysis.concurrency_check import \
             check_concurrency
-        findings.extend(check_concurrency(sources))
+        timed("concurrency", lambda: check_concurrency(sources))
     if "fs" in checks:
         from galah_tpu.analysis.fs_check import check_fs_file
-        for src in sources.values():
-            findings.extend(check_fs_file(src))
+        timed("fs", per_file(check_fs_file))
     if "determinism" in checks:
         from galah_tpu.analysis.determinism_check import \
             check_determinism_file
-        for src in sources.values():
-            findings.extend(check_determinism_file(src))
+        timed("determinism", per_file(check_determinism_file))
     if "pipeline" in checks:
         from galah_tpu.analysis.pipeline_check import \
             check_pipeline_file
-        for src in sources.values():
-            findings.extend(check_pipeline_file(src))
+        timed("pipeline", per_file(check_pipeline_file))
+    if "effects" in checks:
+        from galah_tpu.analysis.effects_check import check_effects
+        from galah_tpu.analysis.ir import IRCache
+        timed("effects",
+              lambda: check_effects(sources,
+                                    cache=IRCache(ir_cache_dir)))
     if "suppressions" in checks:
-        for src in sources.values():
-            findings.extend(core.check_suppression_expiry(src))
+        timed("suppressions", per_file(core.check_suppression_expiry))
     return findings
 
 
 def run_lint(root: Optional[str] = None,
              checks: Sequence[str] = CHECK_NAMES,
-             baseline_path: Optional[str] = None) -> List[Finding]:
+             baseline_path: Optional[str] = None,
+             ir_cache_dir: Optional[str] = None) -> List[Finding]:
     """Full lint pass with suppressions applied; the library entry
     point used by tests and the CLI."""
     root = root or repo_root()
     sources = load_sources(root)
-    findings = run_checks(sources, checks)
+    findings = run_checks(sources, checks, ir_cache_dir=ir_cache_dir)
     baseline = core.load_baseline(baseline_path or DEFAULT_BASELINE)
     core.apply_suppressions(findings, sources, baseline)
     return findings
@@ -194,9 +226,24 @@ def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--run-report", default=None,
                         help="write run_report.json with the lint "
                              "summary attached (per-family counts, "
-                             "suppressed count) so `galah-tpu report "
-                             "--diff` shows lint drift between runs. "
-                             "Env equivalent: GALAH_OBS_REPORT")
+                             "suppressed count, per-family timings) "
+                             "so `galah-tpu report --diff` shows lint "
+                             "drift between runs. Env equivalent: "
+                             "GALAH_OBS_REPORT")
+    parser.add_argument("--sarif", default=None, metavar="PATH",
+                        help="additionally write the findings as a "
+                             "SARIF 2.1.0 log to PATH so CI systems "
+                             "can annotate them inline (suppressed "
+                             "findings are carried with SARIF "
+                             "suppressions rather than dropped)")
+    parser.add_argument("--ir-cache-dir", default=None, metavar="DIR",
+                        help="content-hash cache directory for the "
+                             "GalahIR per-file entries (effects "
+                             "family) and the GL5xx shapes verdict; a "
+                             "warm cache cuts lint wall time by the "
+                             "whole jax-tracing cost. Env equivalent: "
+                             "GALAH_TPU_IR_CACHE. Unset disables "
+                             "caching")
 
 
 def main(argv: Optional[Sequence[str]] = None,
@@ -226,21 +273,29 @@ def main(argv: Optional[Sequence[str]] = None,
 
     root = args.root or repo_root()
     checks = tuple(args.checks) if args.checks else CHECK_NAMES
+    ir_cache_dir = getattr(args, "ir_cache_dir", None)
+    if ir_cache_dir is None:
+        from galah_tpu.analysis.ir import default_cache_dir
+        ir_cache_dir = default_cache_dir()
     changed: Optional[Set[str]] = None
     if getattr(args, "changed_only", False):
         changed = changed_files(root)
         if changed is None:
             sys.stderr.write("galah-tpu lint: --changed-only needs a "
                              "git checkout; scanning everything\n")
-        elif not args.checks and not any(
+        elif not args.checks and not ir_cache_dir and not any(
                 p.startswith("galah_tpu/ops/")
                 or p == "galah_tpu/analysis/shapes.py"
                 for p in changed):
             # the shapes family traces every op through jax — skip it
-            # when no kernel/op code changed (seconds per commit)
+            # when no kernel/op code changed (seconds per commit); a
+            # configured IR cache makes the warm verdict cheap enough
+            # to always run instead
             checks = tuple(c for c in checks if c != "shapes")
     sources = load_sources(root)
-    findings = run_checks(sources, checks)
+    timings: Dict[str, float] = {}
+    findings = run_checks(sources, checks, ir_cache_dir=ir_cache_dir,
+                          timings=timings)
     baseline_path = args.baseline or DEFAULT_BASELINE
 
     if args.update_baseline:
@@ -264,7 +319,19 @@ def main(argv: Optional[Sequence[str]] = None,
         from galah_tpu import obs
         obs.finalize("lint", report_path=report_path,
                      started_at=started_at,
-                     lint=core.lint_summary(findings))
+                     lint=core.lint_summary(findings,
+                                            timings=timings))
+
+    sarif_path = getattr(args, "sarif", None)
+    if sarif_path:
+        import json as _json
+
+        from galah_tpu import __version__
+        with open(sarif_path, "w", encoding="utf-8") as fh:
+            _json.dump(core.render_sarif(findings,
+                                         tool_version=__version__),
+                       fh, indent=1, sort_keys=True)
+            fh.write("\n")
 
     if args.json:
         print(core.render_json(findings))
@@ -272,6 +339,9 @@ def main(argv: Optional[Sequence[str]] = None,
         print(core.render_human(
             findings, show_suppressed=args.show_suppressed))
         dt = time.monotonic() - t0
+        slowest = sorted(timings.items(), key=lambda kv: -kv[1])[:3]
+        per_family = " ".join(f"{k}={v:.1f}s" for k, v in slowest)
         print(f"scanned {len(sources)} files with "
-              f"{len(checks)} checker families in {dt:.1f}s")
+              f"{len(checks)} checker families in {dt:.1f}s"
+              + (f" (slowest: {per_family})" if per_family else ""))
     return 1 if bad else 0

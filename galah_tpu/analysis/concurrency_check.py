@@ -158,7 +158,7 @@ class _Module:
                             and isinstance(v.func, ast.Name)
                             and v.func.id in self.classes):
                         self.instances[t.id] = v.func.id
-        for node in ast.walk(self.src.tree):
+        for node in self.src.walk():
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.name.startswith("galah_tpu"):
@@ -647,7 +647,7 @@ def _check_adoption(m: _Module) -> List[Finding]:
     """GL804 over one annotated module."""
     out: List[Finding] = []
     defs = _adopting_defs(m.src.tree)
-    for node in ast.walk(m.src.tree):
+    for node in m.src.walk():
         if not isinstance(node, ast.Call):
             continue
         fn = node.func

@@ -86,6 +86,15 @@ class SourceFile:
     _ignores: Dict[int, Tuple[frozenset, Optional[datetime.date],
                               str]] = \
         dataclasses.field(default_factory=dict)
+    # lazily-computed preorder node list shared by every checker
+    # family (walk()) and the lazily-computed content digest shared by
+    # the IR cache (content_hash()); both belong to THIS parse so a
+    # lint invocation traverses/hashes each file once, not once per
+    # family.
+    _walk_cache: Optional[list] = dataclasses.field(
+        default=None, repr=False, compare=False)
+    _hash_cache: Optional[str] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     @classmethod
     def load(cls, path: str, rel_to: Optional[str] = None) -> "SourceFile":
@@ -96,6 +105,27 @@ class SourceFile:
         src = cls(path=rel, text=text, tree=tree)
         src._index_suppressions()
         return src
+
+    def walk(self) -> list:
+        """Preorder list of every AST node, computed once per parse.
+
+        ``ast.walk`` re-traverses (and re-allocates the BFS queue for)
+        the whole tree on every call; with eleven-plus checker families
+        each walking every file, the shared list is the cheapest way to
+        pay the traversal once per lint invocation. Callers must not
+        mutate the returned list."""
+        if self._walk_cache is None:
+            self._walk_cache = list(ast.walk(self.tree))
+        return self._walk_cache
+
+    def content_hash(self) -> str:
+        """sha256 of the source text — the IR-cache key component, so a
+        byte-identical file maps to the same cached per-file IR no
+        matter where the checkout lives."""
+        if self._hash_cache is None:
+            self._hash_cache = hashlib.sha256(
+                self.text.encode("utf-8", "replace")).hexdigest()
+        return self._hash_cache
 
     _IGNORE_RE = re.compile(
         r"#\s*galah-lint:\s*ignore\[([A-Z0-9,\s*]+)\]"
@@ -366,14 +396,17 @@ def family_of(code: str) -> str:
     return code
 
 
-def lint_summary(findings: Sequence[Finding]) -> dict:
-    """Counts block shared by --json output and run_report.json."""
+def lint_summary(findings: Sequence[Finding],
+                 timings: Optional[Dict[str, float]] = None) -> dict:
+    """Counts block shared by --json output and run_report.json.
+    ``timings`` (checker family -> wall seconds) rides along when the
+    caller measured it, so run-report diffs expose lint-stage drift."""
     active = [f for f in findings if not f.suppressed]
     by_family: Dict[str, int] = {}
     for f in active:
         fam = family_of(f.code)
         by_family[fam] = by_family.get(fam, 0) + 1
-    return {
+    out = {
         "errors": sum(1 for f in active
                       if f.severity == Severity.ERROR),
         "warnings": sum(1 for f in active
@@ -383,6 +416,10 @@ def lint_summary(findings: Sequence[Finding]) -> dict:
         "suppressed": sum(1 for f in findings if f.suppressed),
         "by_family": dict(sorted(by_family.items())),
     }
+    if timings is not None:
+        out["timings_s"] = {k: round(v, 3)
+                            for k, v in sorted(timings.items())}
+    return out
 
 
 def render_json(findings: Sequence[Finding]) -> str:
@@ -391,6 +428,73 @@ def render_json(findings: Sequence[Finding]) -> str:
         "findings": [f.to_dict() for f in findings],
         "summary": lint_summary(findings),
     }, indent=1, sort_keys=True)
+
+
+#: SARIF 2.1.0 constants for --sarif output (consumed by CI annotators).
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+_SARIF_LEVEL = {Severity.ERROR: "error", Severity.WARNING: "warning",
+                Severity.INFO: "note"}
+
+
+def render_sarif(findings: Sequence[Finding],
+                 tool_version: str = "0") -> dict:
+    """The findings as a SARIF 2.1.0 log dict (one run, one result per
+    finding). Suppressed findings are carried with a populated SARIF
+    ``suppressions`` array rather than dropped, so CI systems show them
+    greyed out instead of losing the paper trail; ``line`` 0
+    (file-level findings) maps to startLine 1, the smallest region
+    SARIF allows."""
+    rules: Dict[str, dict] = {}
+    results: List[dict] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.code)):
+        rules.setdefault(f.code, {
+            "id": f.code,
+            "name": f.code,
+            "shortDescription": {"text": f"galah-tpu lint {f.code} "
+                                         f"({family_of(f.code)} family)"},
+        })
+        result = {
+            "ruleId": f.code,
+            "level": _SARIF_LEVEL[f.severity],
+            "message": {"text": (f"{f.message} [{f.symbol}]"
+                                 if f.symbol else f.message)},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "partialFingerprints": {
+                "galahLintFingerprint/v1": f.fingerprint(),
+            },
+        }
+        if f.suppressed:
+            result["suppressions"] = [{
+                "kind": ("inSource" if f.suppression == "inline"
+                         else "external"),
+            }]
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "galah-tpu lint",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "version": tool_version,
+                "rules": [rules[c] for c in sorted(rules)],
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }
 
 
 def failing(findings: Sequence[Finding],

@@ -209,7 +209,7 @@ def _check_hash_order(src: SourceFile) -> List[Finding]:
     # module-wide linear map of names assigned set-typed values; a
     # later non-set rebind clears the entry (lexical, good enough)
     set_names: Dict[str, int] = {}
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name):
             if _set_typed(node.value, set_names):
@@ -224,7 +224,7 @@ def _check_hash_order(src: SourceFile) -> List[Finding]:
             "feed device buffers or pair ordering; wrap in sorted() "
             "(dict iteration is insertion-ordered and fine)"))
 
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if isinstance(node, ast.For) and _set_typed(node.iter,
                                                     set_names):
             flag(node.lineno, "for-loop over")
@@ -244,7 +244,7 @@ def _check_hash_order(src: SourceFile) -> List[Finding]:
 
 def _check_rng(src: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if not isinstance(node, ast.Call):
             continue
         name = dotted_name(node.func)

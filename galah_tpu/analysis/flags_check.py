@@ -58,7 +58,7 @@ def enumerate_references(src: SourceFile) -> \
         fn = owner.get(node)
         return fn.name if fn is not None else ""
 
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if isinstance(node, ast.Call):
             cname = dotted_name(node.func)
             tail = ".".join(cname.split(".")[-2:])

@@ -96,7 +96,7 @@ def check_fs_file(src: SourceFile) -> List[Finding]:
     if not in_scope(src.path):
         return []
     findings: List[Finding] = []
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if not isinstance(node, ast.Call):
             continue
         name = dotted_name(node.func)

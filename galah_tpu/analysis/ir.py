@@ -1,0 +1,1050 @@
+"""GalahIR: whole-program call-graph + effect IR for the GL11xx family.
+
+Every exactness and performance guarantee this repo enforces statically
+— the megakernel's "no host sync inside a device round" contract, the
+durable-write protocol, the streaming-pipeline discipline — is audited
+by *lexical* per-file checkers that a one-level helper indirection
+silently defeats: a ``device_round`` body calling a local ``_sync()``
+wrapper around ``.item()`` passes GL1006 today. GalahIR closes that
+hole with a package-wide pass:
+
+  1. **Per-file IR extraction** (:class:`ModuleIR`): one AST walk per
+     file harvesting every function (methods and nested defs included),
+     its *direct effect witnesses*, its outgoing call edges (plain
+     calls, ``functools.partial`` targets, function references passed
+     as arguments — ``jax.lax.while_loop`` bodies, ``map`` callables —
+     and pool-submitted callbacks), the module's import/alias tables,
+     and the machine-readable annotations the auditors key off
+     (``PIPELINE_STAGE["device_round"]``, ``GUARDED_BY``).
+  2. **Linking** (:class:`ProgramIR`): module-qualified name resolution
+     across files (``import x as y``, ``from x import y as z``,
+     module-level function aliases, class-instance method dispatch),
+     decorator unwrapping (``@profiled``/``@jit`` never hide a body).
+  3. **Effect propagation to fixpoint** over the call graph, with one
+     provenance *witness chain* kept per (function, effect) so findings
+     carry the exact ``caller -> helper -> sink file:line`` path.
+
+Inferred effects (:data:`EFFECTS`):
+
+  ``host_sync``        ``.item()`` / ``np.asarray`` / ``device_get`` /
+                       ``block_until_ready`` — forces a device->host
+                       round-trip (the GL1006/GL1101 sink set)
+  ``device_dispatch``  a jit-decorated body or a ``pallas_call`` site
+  ``fs_write``         write-mode ``open()``/``os.fdopen`` or a
+                       tmp+rename idiom call (the GL806/GL1102 sink
+                       set); never propagates OUT of the sanctioned
+                       writer ``io/atomic.py``
+  ``lock_acquire``     a bare ``.acquire()`` call
+  ``blocking_io``      ``time.sleep``, ``subprocess.run/check_*``,
+                       a Future ``.result()``, ``Event.wait``
+  ``materialize``      ``list``/``sorted``/``tuple`` over a streamed
+                       producer (the GL1001/GL1103 sink set)
+  ``unseeded_rng``     global-state ``random.*`` / ``np.random.*`` or
+                       a no-argument ``Random()``/``default_rng()``
+
+Effects propagate across plain call edges and function-reference edges
+(the callee runs on the caller's thread); they deliberately do NOT
+propagate across pool-submit/Thread-target edges (the callee runs
+later, elsewhere — GL1105 audits those separately).
+
+**Caching**: per-file IR is content-hash keyed (sha256 of the source
+text + :data:`IR_VERSION`) under the same discipline as the sketch
+diskcache (``io/diskcache.py``): one JSON entry per file written
+through ``io/atomic.py``, corrupt entries dropped and rebuilt, the
+cache strictly optional (``IRCache(None)`` is a no-op). A warm cache
+skips the per-file extraction walk; linking and the fixpoint always
+run fresh (they are cross-file and cheap). The same cache directory
+also holds the GL5xx shapes-family verdict (see ``shapes.py``), which
+is what makes a warm ``galah-tpu lint`` wall a fraction of a cold one.
+
+**Known precision limits** (documented, not bugs): dynamic dispatch
+through ``getattr``/dicts-of-callables is invisible; a method call on
+a value of unknown class (``obj.meth()`` where ``obj`` is a parameter)
+does not resolve; effects of third-party code (numpy, jax) are only
+modeled through the explicit sink sets above.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from galah_tpu.analysis.core import SourceFile, dotted_name
+
+logger = logging.getLogger(__name__)
+
+#: Bump on ANY change to extraction or the serialized shape: the cache
+#: key includes it, so stale entries miss instead of lying.
+IR_VERSION = 1
+
+#: The effect lattice (a powerset over this alphabet; join = union).
+EFFECTS = ("host_sync", "device_dispatch", "fs_write", "lock_acquire",
+           "blocking_io", "materialize", "unseeded_rng")
+
+# -- effect sink sets -------------------------------------------------------
+
+#: Last-component call names that force a device->host transfer (kept
+#: identical to pipeline_check.DEVICE_ROUND_SYNC_CALLS so GL1101 is an
+#: exact transitive extension of lexical GL1006).
+HOST_SYNC_LASTS = frozenset({"asarray", "item", "device_get",
+                             "block_until_ready"})
+
+#: Dotted call names of a hand-rolled durable-write idiom (GL806 set).
+FS_IDIOM_CALLS = frozenset({
+    "os.replace", "os.rename", "tempfile.mkstemp",
+    "tempfile.NamedTemporaryFile",
+})
+
+#: Dotted call names that block the calling thread.
+BLOCKING_CALLS = frozenset({
+    "time.sleep", "subprocess.run", "subprocess.check_output",
+    "subprocess.check_call", "select.select",
+})
+#: Last-component names that block when called on futures/events; kept
+#: narrow (``.result``/``.wait`` on arbitrary objects is the common
+#: blocking idiom in this codebase's pool code).
+BLOCKING_LASTS = frozenset({"result"})
+
+#: Materializers + the streamed-producer shape (GL1001's definitions).
+MATERIALIZERS = frozenset({"list", "sorted", "tuple"})
+STREAMING_PREFIX = "iter_"
+STREAMING_SUFFIX = "_streamed"
+STREAMING_NAMES = frozenset({"process_stream"})
+
+#: Global-state RNG (determinism_check's GL904 sets, minus seeded forms).
+RANDOM_GLOBAL_FNS = frozenset({
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "expovariate",
+    "betavariate", "triangular", "getrandbits", "randbytes",
+})
+NP_RANDOM_GLOBAL_FNS = frozenset({
+    "random", "rand", "randn", "randint", "random_sample", "ranf",
+    "choice", "shuffle", "permutation", "uniform", "normal", "beta",
+    "binomial", "poisson", "exponential", "standard_normal",
+})
+
+#: The one sanctioned durable writer: fs_write never propagates out of
+#: functions defined here (callers *through* atomic are, by
+#: construction, crash-consistent — that's the whole point of GL806).
+SANCTIONED_WRITER = "galah_tpu/io/atomic.py"
+
+_WRITE_MODE_CHARS = frozenset("wax+")
+
+#: A function key: (repo-relative path, dotted qualname within file).
+FuncKey = Tuple[str, str]
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_streaming_name(name: str) -> bool:
+    n = _last(name)
+    return (n.startswith(STREAMING_PREFIX)
+            or n.endswith(STREAMING_SUFFIX) or n in STREAMING_NAMES)
+
+
+def _literal_open_mode(node: ast.Call) -> Optional[str]:
+    mode_node: Optional[ast.AST] = None
+    if len(node.args) >= 2:
+        mode_node = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if (isinstance(mode_node, ast.Constant)
+            and isinstance(mode_node.value, str)):
+        return mode_node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Per-function IR
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CallEdge:
+    """One outgoing edge, unresolved (resolution is a link-time step).
+
+    ``kind``: ``call`` (plain invocation), ``ref`` (a function
+    reference/partial passed as an argument — runs on this thread,
+    effects propagate), ``submit`` (pool.submit / Thread target — runs
+    elsewhere, audited by GL1105 instead of propagated)."""
+
+    name: str       # dotted callee expression as written
+    line: int
+    kind: str = "call"
+
+    def to_list(self) -> list:
+        return [self.name, self.line, self.kind]
+
+    @classmethod
+    def from_list(cls, raw: list) -> "CallEdge":
+        return cls(name=raw[0], line=int(raw[1]), kind=raw[2])
+
+
+@dataclasses.dataclass
+class FuncIR:
+    """IR for one function/method/nested def."""
+
+    qualname: str                   # "f", "Cls.meth", "outer.inner"
+    line: int
+    # effect -> [line, detail] of the first direct witness in this body
+    direct: Dict[str, List] = dataclasses.field(default_factory=dict)
+    calls: List[CallEdge] = dataclasses.field(default_factory=list)
+    params: List[str] = dataclasses.field(default_factory=list)
+    # parameter names this body materializes directly (list(p)/...)
+    materialized_params: List[str] = \
+        dataclasses.field(default_factory=list)
+    # [param, callee-name, arg-index, line]: p forwarded as positional
+    # arg k of a call — the transitive half of GL1103
+    forwarded_params: List[List] = \
+        dataclasses.field(default_factory=list)
+    # [callee-name, arg-index, line, producer]: a streamed-producer
+    # value passed positionally into a call (the GL1103 pass sites)
+    stream_args: List[List] = dataclasses.field(default_factory=list)
+    # body references timing.adopt/stage_token (the GL804/GL1105 mark)
+    adopts: bool = False
+    # decorator dotted names, outermost first (unwrapped for linking)
+    decorators: List[str] = dataclasses.field(default_factory=list)
+    # [line, receiver] of bare .acquire() calls not covered by a
+    # try/finally release of the same receiver (the GL1104 witnesses)
+    unsafe_acquires: List[List] = \
+        dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "qualname": self.qualname,
+            "line": self.line,
+            "direct": self.direct,
+            "calls": [c.to_list() for c in self.calls],
+            "params": self.params,
+            "materialized_params": self.materialized_params,
+            "forwarded_params": self.forwarded_params,
+            "stream_args": self.stream_args,
+            "adopts": self.adopts,
+            "decorators": self.decorators,
+            "unsafe_acquires": self.unsafe_acquires,
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "FuncIR":
+        return cls(
+            qualname=raw["qualname"], line=int(raw["line"]),
+            direct={k: list(v) for k, v in raw["direct"].items()},
+            calls=[CallEdge.from_list(c) for c in raw["calls"]],
+            params=list(raw["params"]),
+            materialized_params=list(raw["materialized_params"]),
+            forwarded_params=[list(e) for e in raw["forwarded_params"]],
+            stream_args=[list(e) for e in raw["stream_args"]],
+            adopts=bool(raw["adopts"]),
+            decorators=list(raw["decorators"]),
+            unsafe_acquires=[list(e) for e in raw["unsafe_acquires"]],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Per-module IR + extraction
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ModuleIR:
+    """IR for one source file: functions plus the resolution tables."""
+
+    path: str
+    content_hash: str
+    functions: Dict[str, FuncIR] = dataclasses.field(default_factory=dict)
+    # alias -> dotted module ("galah_tpu.ops.minhash") for `import x`
+    # and the module interpretation of `from p import x`
+    import_mods: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # alias -> [dotted module, attr] for `from p import x as y`
+    import_attrs: Dict[str, List[str]] = \
+        dataclasses.field(default_factory=dict)
+    # module-level `name = other` function aliases: name -> dotted RHS
+    aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # module-level instance globals: name -> class name in this module
+    instances: Dict[str, str] = dataclasses.field(default_factory=dict)
+    classes: List[str] = dataclasses.field(default_factory=list)
+    # harvested PIPELINE_STAGE["device_round"] / ["streaming"] lists
+    device_round: List[str] = dataclasses.field(default_factory=list)
+    streaming: List[str] = dataclasses.field(default_factory=list)
+    # declares GUARDED_BY/LOCK_ORDER (the GL804/GL1105 threaded scope)
+    annotated: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "ir_version": IR_VERSION,
+            "path": self.path,
+            "content_hash": self.content_hash,
+            "functions": {q: f.to_dict()
+                          for q, f in self.functions.items()},
+            "import_mods": self.import_mods,
+            "import_attrs": self.import_attrs,
+            "aliases": self.aliases,
+            "instances": self.instances,
+            "classes": self.classes,
+            "device_round": self.device_round,
+            "streaming": self.streaming,
+            "annotated": self.annotated,
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "ModuleIR":
+        return cls(
+            path=raw["path"], content_hash=raw["content_hash"],
+            functions={q: FuncIR.from_dict(f)
+                       for q, f in raw["functions"].items()},
+            import_mods=dict(raw["import_mods"]),
+            import_attrs={k: list(v)
+                          for k, v in raw["import_attrs"].items()},
+            aliases=dict(raw["aliases"]),
+            instances=dict(raw["instances"]),
+            classes=list(raw["classes"]),
+            device_round=list(raw["device_round"]),
+            streaming=list(raw["streaming"]),
+            annotated=bool(raw["annotated"]),
+        )
+
+
+def _harvest_literal(tree: ast.Module, name: str):
+    for node in tree.body:
+        targets: list = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                try:
+                    return ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return None
+    return None
+
+
+class _Extractor:
+    """One-pass AST -> ModuleIR extraction for a single file."""
+
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        self.ir = ModuleIR(path=src.path.replace("\\", "/"),
+                           content_hash=src.content_hash())
+
+    def run(self) -> ModuleIR:
+        ir, tree = self.ir, self.src.tree
+        for key in ("GUARDED_BY", "LOCK_ORDER"):
+            if _harvest_literal(tree, key) is not None:
+                ir.annotated = True
+        stage = _harvest_literal(tree, "PIPELINE_STAGE")
+        if isinstance(stage, dict):
+            for field, dst in (("device_round", ir.device_round),
+                               ("streaming", ir.streaming)):
+                val = stage.get(field, [])
+                if isinstance(val, list):
+                    dst.extend(s for s in val if isinstance(s, str))
+        self._scan_toplevel(tree)
+        # every function def, at any nesting, under its dotted qualname
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._extract_function(node, prefix="")
+            elif isinstance(node, ast.ClassDef):
+                ir.classes.append(node.name)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._extract_function(
+                            item, prefix=node.name + ".")
+        return ir
+
+    def _scan_toplevel(self, tree: ast.Module) -> None:
+        ir = self.ir
+        class_names = {n.name for n in tree.body
+                       if isinstance(n, ast.ClassDef)}
+        func_names = {n.name for n in tree.body
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    ir.import_mods[a.asname or a.name] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    continue  # no relative imports in this tree
+                mod = node.module or ""
+                for a in node.names:
+                    alias = a.asname or a.name
+                    # `from galah_tpu.obs import trace` imports a
+                    # MODULE; `from ...policy import f` a function —
+                    # record both, the linker decides by existence
+                    ir.import_mods.setdefault(alias, f"{mod}.{a.name}")
+                    ir.import_attrs.setdefault(alias, [mod, a.name])
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                v = node.value
+                if (isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Name)
+                        and v.func.id in class_names):
+                    ir.instances[t.id] = v.func.id
+                else:
+                    rhs = dotted_name(v)
+                    if rhs and (rhs in func_names or "." in rhs
+                                or rhs in ir.import_mods
+                                or rhs in ir.import_attrs):
+                        # `slab_fold = _slab_fold_jit` style alias
+                        ir.aliases[t.id] = rhs
+
+    # -- one function ------------------------------------------------
+
+    def _extract_function(self, node: ast.AST, prefix: str) -> None:
+        qual = prefix + node.name
+        fn = FuncIR(qualname=qual, line=node.lineno,
+                    params=[a.arg for a in (node.args.posonlyargs
+                                            + node.args.args)])
+        for dec in node.decorator_list:
+            dn = dotted_name(dec if not isinstance(dec, ast.Call)
+                             else dec.func)
+            if dn:
+                fn.decorators.append(dn)
+            if _last(dn) == "jit" or (
+                    isinstance(dec, ast.Call) and dec.args
+                    and _last(dotted_name(dec.args[0])) == "jit"):
+                fn.direct.setdefault(
+                    "device_dispatch",
+                    [node.lineno, "jit-decorated body"])
+        self.ir.functions[qual] = fn
+        self._walk_body(node, fn, qual)
+
+    def _walk_body(self, node: ast.AST, fn: FuncIR, qual: str) -> None:
+        # names bound to a streamed producer inside this body
+        bound_streams: Set[str] = set()
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)
+                    and _is_streaming_name(
+                        dotted_name(sub.value.func))):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        bound_streams.add(t.id)
+
+        def visit(n: ast.AST) -> None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n is not node:
+                # nested def: its own FuncIR; the enclosing function
+                # only reaches it through an explicit edge
+                self._extract_function(n, prefix=fn.qualname + ".")
+                return
+            if isinstance(n, (ast.Attribute, ast.Name)):
+                ident = n.attr if isinstance(n, ast.Attribute) else n.id
+                if ident in ("adopt", "stage_token"):
+                    fn.adopts = True
+            if isinstance(n, ast.Call):
+                self._extract_call(n, fn, bound_streams)
+            for child in ast.iter_child_nodes(n):
+                visit(child)
+
+        # body statements only: decorator expressions are def-time
+        # machinery (handled in _extract_function), not body effects
+        for child in node.body:
+            visit(child)
+        self._find_unsafe_acquires(node, fn)
+
+    def _effect(self, fn: FuncIR, effect: str, line: int,
+                detail: str) -> None:
+        fn.direct.setdefault(effect, [line, detail])
+
+    def _extract_call(self, call: ast.Call, fn: FuncIR,
+                      bound_streams: Set[str]) -> None:
+        name = dotted_name(call.func)
+        last = _last(name)
+        line = call.lineno
+
+        # ---- direct effects ----
+        if last in HOST_SYNC_LASTS:
+            self._effect(fn, "host_sync", line, f"{name}()")
+        if last == "pallas_call":
+            self._effect(fn, "device_dispatch", line, f"{name}()")
+        if name in FS_IDIOM_CALLS:
+            self._effect(fn, "fs_write", line, f"{name}()")
+        elif name in ("open", "os.fdopen"):
+            mode = _literal_open_mode(call)
+            if mode is not None and any(c in _WRITE_MODE_CHARS
+                                        for c in mode):
+                self._effect(fn, "fs_write", line,
+                             f"write-mode {name}()")
+        if last == "acquire" and "." in name:
+            self._effect(fn, "lock_acquire", line, f"{name}()")
+        if name in BLOCKING_CALLS or (last in BLOCKING_LASTS
+                                      and "." in name):
+            self._effect(fn, "blocking_io", line, f"{name}()")
+        self._extract_rng(call, fn, name, last, line)
+
+        # ---- materialization (direct + param forms) ----
+        if (isinstance(call.func, ast.Name)
+                and call.func.id in MATERIALIZERS and call.args):
+            arg = call.args[0]
+            if (isinstance(arg, ast.Call)
+                    and _is_streaming_name(dotted_name(arg.func))):
+                self._effect(
+                    fn, "materialize", line,
+                    f"{call.func.id}() over "
+                    f"{_last(dotted_name(arg.func))}()")
+            elif isinstance(arg, ast.Name):
+                if arg.id in bound_streams:
+                    self._effect(
+                        fn, "materialize", line,
+                        f"{call.func.id}() over streamed {arg.id}")
+                if arg.id in fn.params and \
+                        arg.id not in fn.materialized_params:
+                    fn.materialized_params.append(arg.id)
+
+        # ---- call edges ----
+        if name:
+            fn.calls.append(CallEdge(name=name, line=line))
+        is_submit = (isinstance(call.func, ast.Attribute)
+                     and call.func.attr == "submit")
+        thread_target: Optional[ast.AST] = None
+        if name in ("threading.Thread", "Thread"):
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    thread_target = kw.value
+        arg_exprs = list(call.args) + [kw.value for kw in call.keywords]
+        for idx, arg in enumerate(call.args):
+            # a parameter forwarded positionally: the GL1103 half-edge
+            if isinstance(arg, ast.Name) and arg.id in fn.params \
+                    and name:
+                fn.forwarded_params.append([arg.id, name, idx, line])
+            # a streamed producer passed into a call: GL1103 pass site
+            if name and call.func is not arg:
+                if (isinstance(arg, ast.Call)
+                        and _is_streaming_name(dotted_name(arg.func))):
+                    fn.stream_args.append(
+                        [name, idx, line,
+                         _last(dotted_name(arg.func))])
+                elif isinstance(arg, ast.Name) \
+                        and arg.id in bound_streams:
+                    fn.stream_args.append([name, idx, line, arg.id])
+        for arg in arg_exprs:
+            target = arg
+            kind = "ref"
+            if (isinstance(arg, ast.Call)
+                    and _last(dotted_name(arg.func)) == "partial"
+                    and arg.args):
+                target = arg.args[0]   # functools.partial(f, ...) -> f
+            ref = dotted_name(target)
+            if not ref or ref in ("self", "None", "True", "False"):
+                continue
+            if is_submit and arg is (call.args[0] if call.args
+                                     else None):
+                kind = "submit"
+            elif thread_target is not None and arg is thread_target:
+                kind = "submit"
+            fn.calls.append(CallEdge(name=ref, line=arg.lineno
+                                     if hasattr(arg, "lineno")
+                                     else line, kind=kind))
+        # pool.submit(wrapper(f), x): the wrapper call is the callable
+        if is_submit and call.args and isinstance(call.args[0],
+                                                  ast.Call):
+            wname = dotted_name(call.args[0].func)
+            if wname:
+                fn.calls.append(CallEdge(name=wname,
+                                         line=call.args[0].lineno,
+                                         kind="submit"))
+
+    def _extract_rng(self, call: ast.Call, fn: FuncIR, name: str,
+                     last: str, line: int) -> None:
+        parts = name.split(".")
+        if len(parts) == 2 and parts[0] == "random" \
+                and parts[1] in RANDOM_GLOBAL_FNS:
+            self._effect(fn, "unseeded_rng", line, f"{name}()")
+        elif len(parts) >= 2 and parts[-2] == "random" \
+                and parts[0] in ("np", "numpy") \
+                and parts[-1] in NP_RANDOM_GLOBAL_FNS:
+            self._effect(fn, "unseeded_rng", line, f"{name}()")
+        elif last in ("Random", "RandomState", "default_rng") \
+                and not call.args and not call.keywords:
+            self._effect(fn, "unseeded_rng", line, f"{name}() unseeded")
+
+    # -- GL1104 witnesses --------------------------------------------
+
+    def _find_unsafe_acquires(self, node: ast.AST, fn: FuncIR) -> None:
+        """Bare ``X.acquire()`` statements not covered by a
+        try/finally that releases the same receiver. Sanctioned
+        shapes::
+
+            lock.acquire()                 try:
+            try:                               lock.acquire()
+                ...                            ...
+            finally:                       finally:
+                lock.release()                 lock.release()
+
+        A ``return self.acquire()`` passthrough (context-manager
+        delegation) is exempt — the caller owns the release."""
+
+        simple = (ast.Expr, ast.Assign, ast.AnnAssign, ast.AugAssign,
+                  ast.Return, ast.Assert, ast.Raise)
+
+        def acquires_in(n: ast.AST) -> List[Tuple[int, str]]:
+            found: List[Tuple[int, str]] = []
+            for c in ast.walk(n):
+                if (isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Attribute)
+                        and c.func.attr == "acquire"):
+                    recv = dotted_name(c.func.value)
+                    if recv:
+                        found.append((c.lineno, recv))
+            return found
+
+        def releases(try_node: ast.Try, receiver: str) -> bool:
+            for sub in try_node.finalbody:
+                for c in ast.walk(sub):
+                    if (isinstance(c, ast.Call)
+                            and isinstance(c.func, ast.Attribute)
+                            and c.func.attr == "release"
+                            and dotted_name(c.func.value) == receiver):
+                        return True
+            return False
+
+        def scan(body: List[ast.stmt],
+                 guard: Optional[ast.Try] = None) -> None:
+            """guard: the enclosing Try whose finally may release an
+            acquire made directly inside its body."""
+            for i, stmt in enumerate(body):
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # nested defs have their own FuncIR
+                if isinstance(stmt, ast.Try):
+                    scan(stmt.body, guard=stmt)
+                    for h in stmt.handlers:
+                        scan(h.body, guard=guard)
+                    scan(stmt.orelse, guard=stmt)
+                    scan(stmt.finalbody, guard=guard)
+                    continue
+                if isinstance(stmt, simple):
+                    if isinstance(stmt, ast.Return):
+                        continue  # passthrough delegation
+                    for line, recv in acquires_in(stmt):
+                        if guard is not None and releases(guard, recv):
+                            continue
+                        nxt = (body[i + 1]
+                               if i + 1 < len(body) else None)
+                        if isinstance(nxt, ast.Try) \
+                                and releases(nxt, recv):
+                            continue
+                        fn.unsafe_acquires.append([line, recv])
+                    continue
+                # compound (If/For/While/With): expression parts are
+                # never a sanctioned acquire position; recurse bodies
+                for field in ("test", "iter"):
+                    sub = getattr(stmt, field, None)
+                    if sub is not None:
+                        for line, recv in acquires_in(sub):
+                            fn.unsafe_acquires.append([line, recv])
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if isinstance(sub, list):
+                        scan(sub, guard=guard)
+
+        scan(getattr(node, "body", []))
+
+
+def extract_module_ir(src: SourceFile) -> ModuleIR:
+    """Per-file IR from an already-parsed SourceFile (no caching)."""
+    return _Extractor(src).run()
+
+
+# ---------------------------------------------------------------------------
+# IR cache (content-hash keyed, diskcache discipline)
+# ---------------------------------------------------------------------------
+
+
+class IRCache:
+    """Per-file IR entries under ``dir``; ``IRCache(None)`` disables.
+
+    Same discipline as io/diskcache.py: entries are keyed by content
+    (sha256 of the source text + IR_VERSION), written through
+    io/atomic.py so concurrent lint runs sharing a cache directory
+    never observe torn entries, and any unreadable/mismatched entry is
+    miss-and-repair — a corrupt cache costs a rebuild, never a wrong
+    IR."""
+
+    def __init__(self, path: Optional[str]) -> None:
+        self.path = path
+        self.hits = 0
+        self.misses = 0
+        if path:
+            os.makedirs(path, exist_ok=True)
+
+    @property
+    def enabled(self) -> bool:
+        return self.path is not None
+
+    def _entry_path(self, path: str, content_hash: str) -> str:
+        # the key covers the repo-relative path too: identical file
+        # contents at two paths (empty __init__.py files) must not
+        # share an entry, because the IR records the owning path
+        key = hashlib.sha256(
+            f"ir|v{IR_VERSION}|{path}|{content_hash}".encode()
+        ).hexdigest()[:32]
+        return os.path.join(self.path, f"ir-{key}.json")
+
+    def load(self, path: str, content_hash: str) -> Optional[ModuleIR]:
+        if not self.enabled:
+            return None
+        entry = self._entry_path(path, content_hash)
+        try:
+            with open(entry, "r", encoding="utf-8") as fh:
+                raw = json.load(fh)
+            if raw.get("ir_version") != IR_VERSION \
+                    or raw.get("content_hash") != content_hash \
+                    or raw.get("path") != path:
+                raise ValueError("key mismatch")
+            ir = ModuleIR.from_dict(raw)
+        except FileNotFoundError:
+            self.misses += 1
+            return None
+        except Exception as exc:  # corrupt entry: miss-and-repair
+            logger.warning("Dropping corrupt IR cache entry %s (%s)",
+                           entry, exc)
+            try:
+                os.unlink(entry)
+            except OSError:
+                pass
+            self.misses += 1
+            return None
+        self.hits += 1
+        return ir
+
+    def store(self, ir: ModuleIR) -> None:
+        if not self.enabled:
+            return
+        from galah_tpu.io import atomic
+
+        atomic.write_json(self._entry_path(ir.path, ir.content_hash),
+                          ir.to_dict(),
+                          site="io.atomic.write[ir-cache]")
+
+    # -- generic small-verdict entries (shapes family reuses this) ----
+
+    def _verdict_path(self, kind: str, digest: str) -> str:
+        return os.path.join(self.path, f"{kind}-{digest[:32]}.json")
+
+    def load_verdict(self, kind: str, digest: str) -> Optional[dict]:
+        if not self.enabled:
+            return None
+        try:
+            with open(self._verdict_path(kind, digest), "r",
+                      encoding="utf-8") as fh:
+                raw = json.load(fh)
+            if raw.get("digest") != digest:
+                raise ValueError("key mismatch")
+            return raw
+        except FileNotFoundError:
+            return None
+        except Exception as exc:
+            logger.warning("Dropping corrupt %s verdict entry (%s)",
+                           kind, exc)
+            try:
+                os.unlink(self._verdict_path(kind, digest))
+            except OSError:
+                pass
+            return None
+
+    def store_verdict(self, kind: str, digest: str,
+                      payload: dict) -> None:
+        if not self.enabled:
+            return
+        from galah_tpu.io import atomic
+
+        payload = dict(payload, digest=digest)
+        atomic.write_json(self._verdict_path(kind, digest), payload,
+                          site=f"io.atomic.write[{kind}-verdict]")
+
+
+def default_cache_dir() -> Optional[str]:
+    """Cache directory from the GALAH_TPU_IR_CACHE flag, or None
+    (disabled). Name + default live once, in config.FLAGS."""
+    from galah_tpu.config import env_value
+
+    return env_value("GALAH_TPU_IR_CACHE") or None
+
+
+# ---------------------------------------------------------------------------
+# Linking + effect fixpoint
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Witness:
+    """Provenance of one (function, effect): either a direct sink in
+    this body, or a call edge whose callee carries the effect."""
+
+    line: int                       # line IN the owning function
+    detail: str                     # sink description for direct
+    callee: Optional[FuncKey] = None   # next hop, None when direct
+
+    @property
+    def direct(self) -> bool:
+        return self.callee is None
+
+
+def _module_path_to_dotted(path: str) -> Optional[str]:
+    p = path.replace("\\", "/")
+    if not p.endswith(".py"):
+        return None
+    p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+class ProgramIR:
+    """All ModuleIRs linked: resolved call graph + effect fixpoint."""
+
+    def __init__(self, modules: Sequence[ModuleIR]) -> None:
+        self.modules: Dict[str, ModuleIR] = {
+            m.path: m for m in modules}
+        # dotted module name -> path (galah_tpu.ops.minhash -> file)
+        self.by_dotted: Dict[str, str] = {}
+        for m in modules:
+            dotted = _module_path_to_dotted(m.path)
+            if dotted:
+                self.by_dotted[dotted] = m.path
+        self.functions: Dict[FuncKey, FuncIR] = {}
+        for m in modules:
+            for qual, fn in m.functions.items():
+                self.functions[(m.path, qual)] = fn
+        self._resolved: Dict[FuncKey,
+                             List[Tuple[FuncKey, int, str]]] = {}
+        self._effects: Dict[FuncKey, Dict[str, Witness]] = {}
+        self._adopts: Dict[FuncKey, bool] = {}
+        self._mat_params: Dict[FuncKey, Dict[str, Witness]] = {}
+        self._link()
+        self._fixpoint()
+
+    # -- name resolution ---------------------------------------------
+
+    def resolve(self, mod: ModuleIR, caller_qual: str,
+                name: str) -> Optional[FuncKey]:
+        """(path, qualname) for a dotted callee expression, or None.
+
+        Resolution order: nested defs of the caller (innermost-out),
+        module functions/classes, module-level aliases, imports (module
+        and from-import interpretations), instance-method dispatch,
+        absolute ``galah_tpu.x.y.f`` chains. ``self.meth`` resolves
+        within the caller's class."""
+        if not name:
+            return None
+        parts = name.split(".")
+        # self.meth inside a method
+        if parts[0] == "self" and len(parts) == 2 \
+                and "." in caller_qual:
+            cls = caller_qual.split(".", 1)[0]
+            key = (mod.path, f"{cls}.{parts[1]}")
+            if key in self.functions:
+                return key
+            return None
+        if len(parts) == 1:
+            n = parts[0]
+            # nested def lookup, innermost enclosing scope outwards
+            scope = caller_qual
+            while scope:
+                key = (mod.path, f"{scope}.{n}")
+                if key in self.functions:
+                    return key
+                scope = scope.rsplit(".", 1)[0] if "." in scope else ""
+            if (mod.path, n) in self.functions:
+                return (mod.path, n)
+            if n in mod.classes:
+                key = (mod.path, f"{n}.__init__")
+                return key if key in self.functions else None
+            if n in mod.aliases and mod.aliases[n] != n:
+                return self.resolve(mod, caller_qual, mod.aliases[n])
+            if n in mod.import_attrs:
+                dmod, attr = mod.import_attrs[n]
+                target = self.by_dotted.get(dmod)
+                if target and (target, attr) in self.functions:
+                    return (target, attr)
+                if target and attr in self.modules[target].classes:
+                    key = (target, f"{attr}.__init__")
+                    return key if key in self.functions else None
+            if n in mod.import_mods:
+                # `from galah_tpu.ops import minhash` then bare call?
+                # (a module is not callable; nothing to resolve)
+                return None
+            return None
+        # dotted: resolve the base, then the attribute
+        base, rest = parts[0], parts[1:]
+        if base in mod.instances and len(rest) == 1:
+            key = (mod.path, f"{mod.instances[base]}.{rest[0]}")
+            return key if key in self.functions else None
+        # longest-prefix module match over the import table and
+        # absolute dotted paths
+        for cut in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:cut])
+            dmod: Optional[str] = None
+            if cut == 1 and base in mod.import_mods:
+                dmod = mod.import_mods[base]
+            elif prefix in self.by_dotted:
+                dmod = prefix
+            if dmod is None:
+                continue
+            target = self.by_dotted.get(dmod)
+            if target is None:
+                continue
+            attr = ".".join(parts[cut:])
+            tmod = self.modules[target]
+            if (target, attr) in self.functions:
+                return (target, attr)
+            if attr in tmod.classes:
+                key = (target, f"{attr}.__init__")
+                return key if key in self.functions else None
+            if attr.split(".")[0] in tmod.aliases:
+                return self.resolve(tmod, "", attr)
+        return None
+
+    def _link(self) -> None:
+        for (path, qual), fn in self.functions.items():
+            mod = self.modules[path]
+            out: List[Tuple[FuncKey, int, str]] = []
+            seen: Set[Tuple[FuncKey, str]] = set()
+            for edge in fn.calls:
+                key = self.resolve(mod, qual, edge.name)
+                if key is None or key == (path, qual):
+                    continue
+                if (key, edge.kind) in seen:
+                    continue
+                seen.add((key, edge.kind))
+                out.append((key, edge.line, edge.kind))
+            self._resolved[(path, qual)] = out
+
+    # -- fixpoint ------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        for key, fn in self.functions.items():
+            self._effects[key] = {
+                eff: Witness(line=w[0], detail=w[1])
+                for eff, w in fn.direct.items()}
+            self._adopts[key] = fn.adopts
+            self._mat_params[key] = {
+                p: Witness(line=fn.line, detail="materialized here")
+                for p in fn.materialized_params}
+        keys = sorted(self.functions)
+        changed = True
+        while changed:
+            changed = False
+            for key in keys:
+                mine = self._effects[key]
+                for callee, line, kind in self._resolved[key]:
+                    if kind == "submit":
+                        continue  # runs elsewhere; GL1105's business
+                    for eff, wit in self._effects[callee].items():
+                        if eff in mine:
+                            continue
+                        if eff == "fs_write" and \
+                                callee[0] == SANCTIONED_WRITER:
+                            continue  # sanctioned boundary
+                        mine[eff] = Witness(line=line, detail="",
+                                            callee=callee)
+                        changed = True
+                    if not self._adopts[key] \
+                            and self._adopts[callee] and kind == "call":
+                        self._adopts[key] = True
+                        changed = True
+                # transitive materialized params: p forwarded as
+                # positional arg k of a callee whose k-th param
+                # materializes
+                fn = self.functions[key]
+                for p, cname, idx, line in fn.forwarded_params:
+                    if p in self._mat_params[key]:
+                        continue
+                    callee = self.resolve(self.modules[key[0]],
+                                          key[1], cname)
+                    if callee is None:
+                        continue
+                    cfn = self.functions[callee]
+                    if idx >= len(cfn.params):
+                        continue
+                    if cfn.params[idx] in self._mat_params[callee]:
+                        self._mat_params[key][p] = Witness(
+                            line=line, detail="", callee=callee)
+                        changed = True
+
+    # -- queries -------------------------------------------------------
+
+    def effects_of(self, key: FuncKey) -> Dict[str, Witness]:
+        return self._effects.get(key, {})
+
+    def adopts(self, key: FuncKey) -> bool:
+        return self._adopts.get(key, False)
+
+    def materializing_param(self, key: FuncKey,
+                            index: int) -> Optional[str]:
+        """The name of callee param `index` when it is materialized
+        (directly or transitively), else None."""
+        fn = self.functions.get(key)
+        if fn is None or index >= len(fn.params):
+            return None
+        p = fn.params[index]
+        return p if p in self._mat_params.get(key, {}) else None
+
+    def witness_chain(self, key: FuncKey,
+                      effect: str) -> List[Tuple[FuncKey, Witness]]:
+        """The provenance path [(owner, witness), ...] from `key` down
+        to the direct sink (bounded by the function count, so a cycle
+        cannot loop forever)."""
+        out: List[Tuple[FuncKey, Witness]] = []
+        seen: Set[FuncKey] = set()
+        cur: Optional[FuncKey] = key
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            wit = self._effects.get(cur, {}).get(effect)
+            if wit is None:
+                break
+            out.append((cur, wit))
+            cur = wit.callee
+        return out
+
+    def render_chain(self, key: FuncKey, effect: str) -> str:
+        """'f -> g -> h: np.asarray() at path.py:42' for messages."""
+        chain = self.witness_chain(key, effect)
+        if not chain:
+            return ""
+        names = " -> ".join(k[1] for k, _ in chain)
+        owner, sink = chain[-1]
+        return (f"{names}: {sink.detail or effect} at "
+                f"{owner[0]}:{sink.line}")
+
+
+# ---------------------------------------------------------------------------
+# Build: sources (+ optional cache) -> ProgramIR
+# ---------------------------------------------------------------------------
+
+
+def build_program_ir(sources: Dict[str, SourceFile],
+                     cache: Optional[IRCache] = None) -> ProgramIR:
+    """ProgramIR over the loaded tree. With a cache, per-file
+    extraction is skipped for content-hash hits; linking and the
+    effect fixpoint always run fresh (cross-file, cheap)."""
+    cache = cache or IRCache(None)
+    modules: List[ModuleIR] = []
+    for src in sources.values():
+        path = src.path.replace("\\", "/")
+        ir = cache.load(path, src.content_hash())
+        if ir is None:
+            ir = extract_module_ir(src)
+            cache.store(ir)
+        modules.append(ir)
+    return ProgramIR(modules)

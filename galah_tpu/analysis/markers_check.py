@@ -98,7 +98,7 @@ def check_markers_file(src: SourceFile,
     module_marks = _module_markers(src.tree)
     if module_marks & HW_MARKERS:
         return []
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if not isinstance(node, (ast.FunctionDef,
                                  ast.AsyncFunctionDef)):
             continue

@@ -187,7 +187,7 @@ def check_obs_file(src: SourceFile) -> List[Finding]:
         return []
     findings: List[Finding] = []
     aliases = _time_aliases(src.tree)
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if not isinstance(node, ast.Call):
             continue
         resolved = _resolve_clock(node, aliases, TIMING_CALLS)
@@ -261,7 +261,7 @@ def _check_flow_discipline(src: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
     mod_names, fn_names = _flow_imports(src.tree)
     uses_flow = False
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if not isinstance(node, ast.Call):
             continue
         fn = node.func
@@ -280,7 +280,7 @@ def _check_flow_discipline(src: SourceFile) -> List[Finding]:
             "its work with obs.flow.record_service()/span() so the "
             "run report's critical path can attribute this stage"))
     aliases = _time_aliases(src.tree, banned=_QUEUE_CLOCKS)
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if not isinstance(node, (ast.Assign, ast.AugAssign)):
             continue
         targets = (node.targets if isinstance(node, ast.Assign)

@@ -129,13 +129,13 @@ def _check_materialization(src: SourceFile) -> List[Finding]:
     out: List[Finding] = []
     # names bound to a streamed producer anywhere in the file
     bound: Dict[str, str] = {}
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if (isinstance(node, ast.Assign)
                 and _is_streaming_call(node.value)):
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     bound[t.id] = _producer_name(node.value)
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Name)
                 and node.func.id in MATERIALIZERS
@@ -232,7 +232,7 @@ def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
 def _check_unbounded(src: SourceFile) -> List[Finding]:
     """GL1003: queue/pool constructions without a depth bound."""
     out: List[Finding] = []
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if not isinstance(node, ast.Call):
             continue
         name = dotted_name(node.func).rsplit(".", 1)[-1]
@@ -271,7 +271,7 @@ def _gauge_emitted(src: SourceFile, gauge: str) -> bool:
     """Any call in the file carrying the gauge name — as a string
     literal, via the PIPELINE_OCCUPANCY_GAUGE constant, or through
     the ``obs.metrics.pipeline_occupancy()`` helper."""
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         if not isinstance(node, ast.Call):
             continue
         if (gauge == OCCUPANCY_GAUGE

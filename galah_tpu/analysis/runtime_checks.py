@@ -142,7 +142,7 @@ def _unhashable_static_defaults(fn: ast.FunctionDef, dec: ast.AST,
 def check_runtime_file(src: SourceFile) -> List[Finding]:
     """GL2xx/GL3xx over one module."""
     findings: List[Finding] = []
-    for fn in ast.walk(src.tree):
+    for fn in src.walk():
         if not isinstance(fn, ast.FunctionDef):
             continue
         dec = _jit_decoration(fn)

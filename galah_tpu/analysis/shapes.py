@@ -229,8 +229,65 @@ def write_snapshot(contracts: Dict[str, Dict[str, str]]) -> None:
         fh.write("\n")
 
 
-def check_shape_contracts() -> List[Finding]:
-    """GL501/GL502: computed lattice vs committed snapshot."""
+def _verdict_digest() -> str:
+    """Content digest over everything the lattice outcome depends on:
+    the op modules the lattice traces, this file (the lattice itself),
+    the committed snapshot, and the jax version. Any edit to any of
+    them changes the digest, so a cached verdict can never go stale —
+    it can only be missed and recomputed."""
+    import hashlib
+
+    h = hashlib.sha256()
+    try:
+        import jax
+
+        h.update(jax.__version__.encode())
+    except Exception:  # noqa: BLE001 - no jax, no cached verdict reuse
+        h.update(b"no-jax")
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.abspath(__file__), SNAPSHOT_PATH,
+             os.path.join(pkg, "parallel", "mesh.py")]
+    ops_dir = os.path.join(pkg, "ops")
+    for base, _dirs, names in sorted(os.walk(ops_dir)):
+        paths.extend(os.path.join(base, n) for n in sorted(names)
+                     if n.endswith(".py"))
+    for p in paths:
+        h.update(os.path.basename(p).encode())
+        try:
+            with open(p, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(b"<absent>")
+    return h.hexdigest()
+
+
+def check_shape_contracts(cache_dir: str = None) -> List[Finding]:
+    """GL501/GL502: computed lattice vs committed snapshot.
+
+    With ``cache_dir`` the verdict (the finding list itself) is cached
+    keyed by a digest over the op sources + lattice + snapshot + jax
+    version — this family costs ~12 s of jax tracing per run, so a
+    warm hit is what makes a cached ``galah-tpu lint`` fast."""
+    cache = None
+    if cache_dir:
+        from galah_tpu.analysis.ir import IRCache
+
+        cache = IRCache(cache_dir)
+        digest = _verdict_digest()
+        hit = cache.load_verdict("shapes", digest)
+        if hit is not None:
+            return [Finding(code, Severity[sev], path, line, msg, sym)
+                    for code, sev, path, line, msg, sym
+                    in hit["findings"]]
+    findings = _check_shape_contracts_cold()
+    if cache is not None:
+        cache.store_verdict("shapes", digest, {
+            "findings": [[f.code, f.severity.name, f.path, f.line,
+                          f.message, f.symbol] for f in findings]})
+    return findings
+
+
+def _check_shape_contracts_cold() -> List[Finding]:
     computed, findings = compute_contracts()
     snapshot = load_snapshot()
     rel = "galah_tpu/analysis/shape_contracts.json"

@@ -204,7 +204,8 @@ def build_parser() -> argparse.ArgumentParser:
         "lint",
         help="Static analysis of the codebase: Pallas kernel "
              "contracts, tracer leaks, flag registry, shape "
-             "contracts, lock discipline, numeric determinism",
+             "contracts, lock discipline, numeric determinism, "
+             "interprocedural effect auditors (GalahIR)",
         description="Run the galah-tpu static-analysis suite "
                     "(equivalent to `python -m galah_tpu.analysis`); "
                     "exits 1 on any unsuppressed finding at WARNING "

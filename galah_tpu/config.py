@@ -113,6 +113,12 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
          help="Directory for the persistent sketch/profile cache; the "
               "--sketch-cache flag's env twin and loses to it. Unset "
               "disables caching"),
+    Flag("GALAH_TPU_IR_CACHE", section="runtime",
+         help="Directory for the lint IR cache (per-file GalahIR "
+              "entries and the GL5xx shapes verdict, content-hash "
+              "keyed); the `galah-tpu lint --ir-cache-dir` flag's env "
+              "twin and loses to it. Unset disables caching",
+         external_reader="analysis/ir.py default_cache_dir"),
     Flag("GALAH_TPU_INDEX_DIR", section="runtime",
          help="Directory of the persistent versioned sketch index; "
               "the --index-dir flag's env twin and loses to it"),

@@ -1,13 +1,24 @@
 #!/bin/bash
 # Pre-commit lint gate: lint only the files git considers changed
 # (staged, unstaged, untracked). Checkers still load the whole tree so
-# cross-module rules (lock order, flag registry) stay sound — only the
-# REPORTING is scoped, and the slow shapes family is skipped unless
-# kernel/op code changed. Exit 1 iff a changed file carries an
-# unsuppressed WARNING-or-worse finding.
+# cross-module rules (lock order, flag registry, the GL11xx effect
+# auditors) stay sound — only the REPORTING is scoped, and the slow
+# shapes family is skipped unless kernel/op code changed (or an IR
+# cache is configured, which makes the warm shapes verdict cheap).
+# Exit 1 iff a changed file carries an unsuppressed WARNING-or-worse
+# finding.
 #
 # Install as a git hook:   ln -s ../../scripts/lint_gate.sh .git/hooks/pre-commit
 # Run by hand:             scripts/lint_gate.sh [--json] [extra lint args]
+#
+# --ir-cache-dir DIR: content-hash cache for the per-file GalahIR
+# entries and the GL5xx shapes verdict (env twin: GALAH_TPU_IR_CACHE).
+# A warm cache cuts the full-lint wall by the whole jax-tracing cost.
+#
+# --self-check [DIR]: cold-vs-warm cache audit. Runs the FULL lint
+# twice against a fresh cache directory (cold populates, warm must
+# hit) and fails unless warm wall <= 60% of cold — the acceptance
+# bound the IR cache exists to meet. DIR defaults to a temp dir.
 #
 # --san: instead of the static lint, run the bounded GalahSan smoke —
 # the sanitizer reproducer suite plus the obs tests (the most
@@ -21,5 +32,31 @@ if [ "${1:-}" = "--san" ]; then
     export GALAH_SAN=1
     exec python -m pytest tests/test_sanitizer.py tests/test_obs.py \
         -q -m 'not slow' -p no:cacheprovider "$@"
+fi
+if [ "${1:-}" = "--self-check" ]; then
+    shift
+    CACHE_DIR="${1:-$(mktemp -d)}"
+    [ $# -gt 0 ] && shift
+    rm -rf "$CACHE_DIR" && mkdir -p "$CACHE_DIR"
+    now_ms() { python -c 'import time; print(int(time.monotonic()*1000))'; }
+    echo "lint self-check: cold run (populating $CACHE_DIR)"
+    T0=$(now_ms)
+    python -m galah_tpu.analysis --ir-cache-dir "$CACHE_DIR" "$@" \
+        || exit 1
+    T1=$(now_ms)
+    echo "lint self-check: warm run"
+    python -m galah_tpu.analysis --ir-cache-dir "$CACHE_DIR" "$@" \
+        || exit 1
+    T2=$(now_ms)
+    COLD=$((T1 - T0)); WARM=$((T2 - T1))
+    echo "lint self-check: cold ${COLD}ms, warm ${WARM}ms"
+    # acceptance bound: warm (IR-cached) wall <= 60% of cold
+    if [ $((WARM * 100)) -gt $((COLD * 60)) ]; then
+        echo "lint self-check: FAIL - warm run is >60% of cold" \
+             "(cache not effective)" >&2
+        exit 1
+    fi
+    echo "lint self-check: OK (warm is $((WARM * 100 / COLD))% of cold)"
+    exit 0
 fi
 exec python -m galah_tpu.analysis --changed-only "$@"

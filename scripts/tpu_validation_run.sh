@@ -125,9 +125,21 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
 # captures that exited 124 with no data died exactly that way).
 BENCH_TIMEOUT=3000
 # Cheap static gate first: kernel contracts, tracer leaks, flag
-# registry, shape snapshots — seconds on the host VM, and a failure
-# here means the expensive hardware stages would exercise broken code.
-run_stage lint 300 python -u -m galah_tpu.analysis --json
+# registry, shape snapshots, and the GL11xx interprocedural effect
+# auditors — seconds on the host VM, and a failure here means the
+# expensive hardware stages would exercise broken code. The IR cache
+# persists across sessions under the artifact root's parent, so every
+# run after the first pays the warm (IR-cached) cost only.
+IR_CACHE="${GALAH_TPU_IR_CACHE:-$(dirname "$ART")/lint_ir_cache}"
+run_stage lint 300 python -u -m galah_tpu.analysis --json \
+  --ir-cache-dir "$IR_CACHE"
+# The effects stage token re-runs the GL11xx family in isolation
+# against the now-warm IR cache: a hardware session records, in its
+# own artifact trail, that the interprocedural contracts (device-round
+# sync-freedom, durable-write routing, stage-token adoption) held for
+# exactly the tree it benchmarked.
+run_stage effects 120 python -u -m galah_tpu.analysis --json \
+  --check effects --ir-cache-dir "$IR_CACHE"
 # GalahSan smoke on the host CPU: the sanitizer reproducer suite plus
 # the lock-heavy obs tests under GALAH_SAN=1 (docs/sanitizer.md). A
 # lock-order or GUARDED_BY violation fails here in seconds rather than

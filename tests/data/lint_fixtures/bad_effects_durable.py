@@ -1,0 +1,42 @@
+"""GL1102/GL1104/GL1105 fixture (loaded as a durable, annotated path).
+
+tests/test_analysis.py loads this under ``galah_tpu/obs/ledger.py``
+(a fs_check.DURABLE_MODULES entry, with GUARDED_BY making it an
+annotated threaded module) and asserts exact lines; keep the layout
+stable.
+"""
+
+import threading
+import time
+
+GUARDED_BY = {"_state": "LOCK"}
+
+LOCK = threading.Lock()
+_state = {}
+
+
+def _dump(path, payload):
+    # the hidden write: one helper level around open() defeats the
+    # lexical GL806 file check
+    with open(path, "w") as fh:         # line 21: the write sink
+        fh.write(payload)
+
+
+def append_record(path, rec):
+    _dump(path, rec)                    # line 26: GL1102 anchors here
+
+
+def rotate():
+    LOCK.acquire()                      # line 30: GL1104 (no finally)
+    _state.clear()
+    LOCK.release()
+
+
+def _flush_cb(path):
+    time.sleep(0.1)                     # effect, and never adopts
+    return path
+
+
+def drain(pool, paths):
+    for p in paths:
+        pool.submit(_flush_cb, p)       # line 42: GL1105 anchors here

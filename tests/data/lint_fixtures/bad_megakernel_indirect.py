@@ -1,0 +1,23 @@
+"""GL1101 fixture: the lexical GL1006 blind spot.
+
+The device-round body never mentions a sync call itself — it routes
+the scalar pull through a local helper — so lexical GL1006 stays
+silent while the interprocedural GL1101 must report the body with the
+full witness chain. Line numbers are asserted exactly in
+tests/test_analysis.py; keep the layout stable.
+"""
+
+PIPELINE_STAGE = {
+    "device_round": ["_fold_round"],
+}
+
+
+def _pull_scalar(count):
+    # the hidden sink: one helper level is all it takes to defeat a
+    # per-function lexical rule
+    return count.item()                 # line 18: the sync sink
+
+
+def _fold_round(qi, qv, count):
+    n = _pull_scalar(count)             # line 22: GL1101 anchors here
+    return qi, qv, n

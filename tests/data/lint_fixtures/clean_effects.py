@@ -1,0 +1,64 @@
+"""GL11xx negative fixture: every sanctioned form of the same shapes.
+
+Loaded under a durable + annotated pipeline path in
+tests/test_analysis.py; no GL11xx code may fire here.
+"""
+
+import threading
+
+from galah_tpu.io import atomic
+from galah_tpu.obs import timing
+
+GUARDED_BY = {"_state": "LOCK"}
+
+LOCK = threading.Lock()
+_state = {}
+
+
+def append_record(path, rec):
+    # the sanctioned durable route: effects stop at io/atomic.py
+    atomic.write_json(path, rec, site="fixture")
+
+
+def rotate_with():
+    with LOCK:
+        _state.clear()
+
+
+def rotate_try():
+    LOCK.acquire()
+    try:
+        _state.clear()
+    finally:
+        LOCK.release()
+
+
+class _Guard:
+    def acquire(self):
+        return True
+
+    def __enter__(self):
+        # passthrough delegation: the caller owns the release
+        return self.acquire()
+
+
+def _flush_cb(token, path):
+    with timing.adopt(token):
+        return path
+
+
+def drain(pool, token, paths):
+    for p in paths:
+        pool.submit(_flush_cb, token, p)
+
+
+def consume_windows():
+    # incremental consumption of a streamed producer is the contract
+    total = 0
+    for w in iter_windows():
+        total += w
+    return total
+
+
+def iter_windows():
+    yield from range(4)

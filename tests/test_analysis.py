@@ -584,10 +584,12 @@ def test_lint_run_report_carries_summary(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(report_path.read_text())
-    assert report["version"] == 7
+    assert report["version"] == 8
     assert report["run"]["subcommand"] == "lint"
     assert set(report["lint"]) == {"errors", "warnings", "notes",
-                                   "suppressed", "by_family"}
+                                   "suppressed", "by_family",
+                                   "timings_s"}
+    assert set(report["lint"]["timings_s"]) == {"suppressions"}
     from galah_tpu.obs import report as report_mod
 
     assert report_mod.validate(report) == []
@@ -827,3 +829,502 @@ def test_repo_pipeline_discipline_holds():
     found = [f for f in run_lint(checks=("pipeline",))
              if not f.suppressed]
     assert not found, [(f.path, f.line, f.message) for f in found]
+
+
+# ---------------------------------------------------------------------------
+# GL11xx: GalahIR interprocedural effect auditors (analysis/ir.py +
+# effects_check.py)
+# ---------------------------------------------------------------------------
+
+
+def _sf(path, text):
+    import ast
+    import textwrap
+
+    text = textwrap.dedent(text)
+    return SourceFile(path=path, text=text, tree=ast.parse(text))
+
+
+def test_gl1101_catches_the_gl1006_lexical_blind_spot():
+    """The flagship case: a helper-wrapped .item() inside a declared
+    device_round body. Lexical GL1006 must stay silent (the blind
+    spot), GL1101 must report the body with the full witness chain."""
+    from galah_tpu.analysis.effects_check import check_effects
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    src = load_fixture("bad_megakernel_indirect.py",
+                       path="galah_tpu/ops/mk_indirect.py")
+    lexical = check_pipeline_file(src)
+    assert "GL1006" not in codes(lexical), \
+        "the fixture must be invisible to the lexical rule"
+    assert lexical == []  # annotation is well-formed too
+
+    found = check_effects({src.path: src})
+    assert [(f.code, f.line, f.symbol) for f in found] == \
+        [("GL1101", 22, "_fold_round")]
+    # the message carries the exact provenance chain down to the sink
+    assert "_fold_round -> _pull_scalar" in found[0].message
+    assert "galah_tpu/ops/mk_indirect.py:18" in found[0].message
+    assert found[0].severity is Severity.WARNING
+
+
+def test_bad_effects_durable_fixture_fires_1102_1104_1105():
+    from galah_tpu.analysis.effects_check import check_effects
+    from galah_tpu.analysis.fs_check import DURABLE_MODULES, \
+        check_fs_file
+
+    path = "galah_tpu/obs/ledger.py"
+    assert path in DURABLE_MODULES
+    src = load_fixture("bad_effects_durable.py", path=path)
+    # lexical GL806 sees the direct open() in _dump...
+    assert "GL806" in codes(check_fs_file(src))
+    found = check_effects({src.path: src})
+    got = sorted((f.code, f.line, f.symbol) for f in found)
+    # ...but only GL1102 sees append_record reaching it transitively
+    assert got == [("GL1102", 26, "append_record"),
+                   ("GL1104", 30, "rotate"),
+                   ("GL1105", 42, "_flush_cb")]
+    by_code = {f.code: f for f in found}
+    assert "append_record -> _dump" in by_code["GL1102"].message
+    assert f"{path}:21" in by_code["GL1102"].message
+    assert "io/atomic.py" in by_code["GL1102"].message
+    assert "try/finally" in by_code["GL1104"].message
+    assert "blocking_io" in by_code["GL1105"].message
+    assert f"{path}:35" in by_code["GL1105"].message  # callee def line
+
+
+def test_bad_effects_stream_fixture_fires_gl1103():
+    from galah_tpu.analysis.effects_check import check_effects
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    src = load_fixture("bad_effects_stream.py",
+                       path="galah_tpu/fleet/stage.py")
+    assert "GL1001" not in codes(check_pipeline_file(src))
+    found = check_effects({src.path: src})
+    assert [(f.code, f.line, f.symbol) for f in found] == \
+        [("GL1103", 17, "iter_windows")]
+    assert "_collect()" in found[0].message
+    assert "'items'" in found[0].message
+
+
+def test_clean_effects_fixture_is_silent():
+    from galah_tpu.analysis.effects_check import check_effects
+
+    # durable AND pipeline-scope AND annotated: every rule is armed,
+    # every idiom in the fixture is the sanctioned form
+    src = load_fixture("clean_effects.py",
+                       path="galah_tpu/index/store.py")
+    assert check_effects({src.path: src}) == []
+
+
+def test_gl1103_scope_excludes_non_pipeline_modules():
+    from galah_tpu.analysis.effects_check import check_effects
+
+    src = load_fixture("bad_effects_stream.py",
+                       path="galah_tpu/obs/stage.py")
+    assert "GL1103" not in codes(check_effects({src.path: src}))
+
+
+def test_gl1104_return_passthrough_and_gl1105_adoption_are_exempt():
+    from galah_tpu.analysis.effects_check import check_effects
+
+    src = _sf("galah_tpu/fleet/x.py", '''
+        GUARDED_BY = {"s": "LOCK"}
+
+        class Guard:
+            def acquire(self):
+                return True
+
+            def __enter__(self):
+                return self.acquire()
+
+        def adopting_cb(token, p):
+            import time
+            with timing.adopt(token):
+                time.sleep(p)
+
+        def drive(pool, token, items):
+            for it in items:
+                pool.submit(adopting_cb, token, it)
+    ''')
+    assert check_effects({src.path: src}) == []
+
+
+# -- IR name resolution and effect propagation units ------------------
+
+
+def _program(*mods):
+    from galah_tpu.analysis import ir
+
+    sources = {m.path: m for m in mods}
+    return ir.build_program_ir(sources)
+
+
+_SINK = _sf("galah_tpu/pkg/sink.py", '''
+    def pull(v):
+        return v.item()
+''')
+
+
+def test_ir_resolves_plain_module_import():
+    prog = _program(_SINK, _sf("galah_tpu/pkg/user.py", '''
+        import galah_tpu.pkg.sink
+        def f(v):
+            return galah_tpu.pkg.sink.pull(v)
+    '''))
+    effects = prog.effects_of(("galah_tpu/pkg/user.py", "f"))
+    assert "host_sync" in effects
+
+
+def test_ir_resolves_import_as_alias():
+    prog = _program(_SINK, _sf("galah_tpu/pkg/user.py", '''
+        import galah_tpu.pkg.sink as sk
+        def f(v):
+            return sk.pull(v)
+    '''))
+    assert "host_sync" in prog.effects_of(
+        ("galah_tpu/pkg/user.py", "f"))
+
+
+def test_ir_resolves_from_import_as():
+    prog = _program(_SINK, _sf("galah_tpu/pkg/user.py", '''
+        from galah_tpu.pkg.sink import pull as grab
+        def f(v):
+            return grab(v)
+    '''))
+    assert "host_sync" in prog.effects_of(
+        ("galah_tpu/pkg/user.py", "f"))
+
+
+def test_ir_resolves_module_level_function_alias():
+    prog = _program(_SINK, _sf("galah_tpu/pkg/user.py", '''
+        from galah_tpu.pkg.sink import pull
+        fetch = pull
+        def f(v):
+            return fetch(v)
+    '''))
+    assert "host_sync" in prog.effects_of(
+        ("galah_tpu/pkg/user.py", "f"))
+
+
+def test_ir_unwraps_decorators():
+    """A @profiled/@jit wrapper never hides the body's effects from
+    callers, and a jit decoration IS a device_dispatch effect."""
+    prog = _program(_sf("galah_tpu/pkg/deco.py", '''
+        import functools
+        import jax
+
+        def profiled(fn):
+            return fn
+
+        @profiled
+        def sync_inner(v):
+            return v.item()
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def fold(n, v):
+            return v + n
+
+        def caller(v):
+            return sync_inner(v)
+    '''))
+    assert "host_sync" in prog.effects_of(
+        ("galah_tpu/pkg/deco.py", "caller"))
+    assert "device_dispatch" in prog.effects_of(
+        ("galah_tpu/pkg/deco.py", "fold"))
+
+
+def test_ir_partial_and_callback_refs_propagate_submit_does_not():
+    from galah_tpu.analysis import ir
+
+    prog = _program(_sf("galah_tpu/pkg/cb.py", '''
+        import functools
+
+        def sink(v):
+            return v.item()
+
+        def via_partial(run, v):
+            return run(functools.partial(sink, v))
+
+        def via_while_loop(lax, cond, v):
+            return lax.while_loop(cond, sink, v)
+
+        def via_submit(pool, v):
+            return pool.submit(sink, v)
+    '''))
+    p = "galah_tpu/pkg/cb.py"
+    # a partial target and a function reference run on this thread
+    assert "host_sync" in prog.effects_of((p, "via_partial"))
+    assert "host_sync" in prog.effects_of((p, "via_while_loop"))
+    # a pool-submitted callee runs elsewhere: never propagated
+    assert "host_sync" not in prog.effects_of((p, "via_submit"))
+    fn = prog.functions[(p, "via_submit")]
+    assert [e.kind for e in fn.calls if e.name == "sink"] == ["submit"]
+
+
+def test_ir_call_graph_cycle_reaches_fixpoint():
+    prog = _program(_sf("galah_tpu/pkg/cyc.py", '''
+        def a(v, n):
+            if n:
+                return b(v, n - 1)
+            return 0
+
+        def b(v, n):
+            v.item()
+            return a(v, n)
+    '''))
+    p = "galah_tpu/pkg/cyc.py"
+    assert "host_sync" in prog.effects_of((p, "a"))
+    assert "host_sync" in prog.effects_of((p, "b"))
+    # the witness chain is cycle-safe and ends at the direct sink
+    chain = prog.witness_chain((p, "a"), "host_sync")
+    assert chain[-1][1].direct
+
+
+def test_ir_nested_defs_and_methods_resolve():
+    prog = _program(_sf("galah_tpu/pkg/nest.py", '''
+        class Folder:
+            def pull(self, v):
+                return v.item()
+
+            def round(self, v):
+                return self.pull(v)
+
+        def outer(v):
+            def inner(x):
+                return x.item()
+            return inner(v)
+    '''))
+    p = "galah_tpu/pkg/nest.py"
+    assert "host_sync" in prog.effects_of((p, "Folder.round"))
+    assert "host_sync" in prog.effects_of((p, "outer"))
+
+
+def test_ir_fs_write_stops_at_the_sanctioned_writer():
+    from galah_tpu.analysis import ir
+
+    atomic_mod = _sf(ir.SANCTIONED_WRITER, '''
+        def write_json(path, obj):
+            with open(path, "w") as fh:
+                fh.write(obj)
+    ''')
+    user = _sf("galah_tpu/obs/ledger.py", '''
+        from galah_tpu.io.atomic import write_json
+        def save(path, rec):
+            write_json(path, rec)
+    ''')
+    prog = _program(atomic_mod, user)
+    # atomic itself carries the effect; its callers do not inherit it
+    assert "fs_write" in prog.effects_of(
+        (ir.SANCTIONED_WRITER, "write_json"))
+    assert "fs_write" not in prog.effects_of(
+        ("galah_tpu/obs/ledger.py", "save"))
+
+
+def test_ir_cache_round_trip_warm_hit_and_corruption_repair(tmp_path):
+    from galah_tpu.analysis import ir
+
+    src = _sf("galah_tpu/pkg/cached.py", '''
+        def f(v):
+            return v.item()
+    ''')
+    cache_dir = str(tmp_path / "irc")
+    cold = ir.IRCache(cache_dir)
+    ir.build_program_ir({src.path: src}, cache=cold)
+    assert (cold.hits, cold.misses) == (0, 1)
+
+    warm = ir.IRCache(cache_dir)
+    prog = ir.build_program_ir({src.path: src}, cache=warm)
+    assert (warm.hits, warm.misses) == (1, 0)
+    assert "host_sync" in prog.effects_of(
+        ("galah_tpu/pkg/cached.py", "f"))
+
+    # corrupt the entry: next load is a miss-and-repair, never a crash
+    entry = pathlib.Path(warm._entry_path(src.path, src.content_hash()))
+    entry.write_text("{not json")
+    repaired = ir.IRCache(cache_dir)
+    prog = ir.build_program_ir({src.path: src}, cache=repaired)
+    assert (repaired.hits, repaired.misses) == (0, 1)
+    assert "host_sync" in prog.effects_of(
+        ("galah_tpu/pkg/cached.py", "f"))
+    assert json.loads(entry.read_text())["ir_version"] == ir.IR_VERSION
+
+
+def test_ir_cache_disabled_is_a_noop(tmp_path):
+    from galah_tpu.analysis import ir
+
+    cache = ir.IRCache(None)
+    assert not cache.enabled
+    src = _sf("galah_tpu/pkg/nocache.py", "def f(v):\n    return v\n")
+    ir.build_program_ir({src.path: src}, cache=cache)
+    assert (cache.hits, cache.misses) == (0, 0)
+
+
+def test_shapes_verdict_cache_round_trips(tmp_path):
+    """The GL5xx warm path must replay the exact cold findings."""
+    from galah_tpu.analysis import ir
+    from galah_tpu.analysis.shapes import _verdict_digest
+
+    digest = _verdict_digest()
+    cache = ir.IRCache(str(tmp_path))
+    assert cache.load_verdict("shapes", digest) is None
+    payload = {"findings": [["GL501", "ERROR", "p.py", 3, "msg", "op"]]}
+    cache.store_verdict("shapes", digest, payload)
+    hit = ir.IRCache(str(tmp_path)).load_verdict("shapes", digest)
+    assert hit["findings"] == payload["findings"]
+    # a different digest (any op-file edit) misses
+    assert cache.load_verdict("shapes", "0" * 64) is None
+
+
+def test_repo_effects_clean():
+    """Tier-1 gate: the package's own call graph carries no GL11xx
+    violations — the interprocedural contracts hold transitively."""
+    found = [f for f in run_lint(checks=("effects",))
+             if not f.suppressed]
+    assert not found, [(f.path, f.line, f.code, f.message)
+                       for f in found]
+
+
+def test_effects_family_is_registered():
+    assert "effects" in CHECK_NAMES
+    src = load_fixture("bad_effects_durable.py",
+                       path="galah_tpu/obs/ledger.py")
+    found = run_checks({src.path: src}, checks=("effects",))
+    assert {"GL1102", "GL1104", "GL1105"} <= set(codes(found))
+    assert core.family_of("GL1101") == "GL11xx"
+
+
+def test_run_checks_timings_cover_requested_families():
+    src = load_fixture("clean_case.py", path="galah_tpu/ops/clean.py")
+    timings = {}
+    run_checks({src.path: src}, checks=("pipeline", "effects"),
+               timings=timings)
+    assert set(timings) == {"pipeline", "effects"}
+    assert all(t >= 0 for t in timings.values())
+    summary = core.lint_summary([], timings=timings)
+    assert set(summary["timings_s"]) == {"pipeline", "effects"}
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 output (--sarif)
+# ---------------------------------------------------------------------------
+
+# Structural subset of the SARIF 2.1.0 schema covering everything we
+# emit (the full OASIS schema is not vendored; this pins the invariants
+# CI annotators rely on: version, driver, rules, results with physical
+# locations and fingerprints).
+_SARIF_SUBSET_SCHEMA = {
+    "type": "object",
+    "required": ["version", "$schema", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "runs": {
+            "type": "array", "minItems": 1, "maxItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {"driver": {
+                            "type": "object",
+                            "required": ["name", "rules"],
+                            "properties": {
+                                "name": {"type": "string"},
+                                "rules": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["id"],
+                                    },
+                                },
+                            },
+                        }},
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["ruleId", "level", "message",
+                                         "locations"],
+                            "properties": {
+                                "level": {"enum": ["error", "warning",
+                                                   "note"]},
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                },
+                                "locations": {
+                                    "type": "array", "minItems": 1,
+                                    "items": {"type": "object"},
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+def test_sarif_rendering_is_schema_valid_and_complete():
+    import jsonschema
+
+    from galah_tpu.analysis.effects_check import check_effects
+
+    src = load_fixture("bad_effects_durable.py",
+                       path="galah_tpu/obs/ledger.py")
+    found = check_effects({src.path: src})
+    assert found
+    found[0].suppressed = True
+    found[0].suppression = "inline"
+    log = core.render_sarif(found, tool_version="0.1.0")
+    jsonschema.validate(log, _SARIF_SUBSET_SCHEMA)
+
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "galah-tpu lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {f.code for f in found} == rule_ids
+    assert len(run["results"]) == len(found)
+    first = run["results"][0]
+    loc = first["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "galah_tpu/obs/ledger.py"
+    assert loc["region"]["startLine"] >= 1
+    assert "galahLintFingerprint/v1" in first["partialFingerprints"]
+    # the suppressed finding is carried, marked, not dropped
+    suppressed = [r for r in run["results"] if r.get("suppressions")]
+    assert len(suppressed) == 1
+
+
+def test_sarif_cli_writes_valid_log(tmp_path):
+    import jsonschema
+
+    sarif_path = tmp_path / "lint.sarif"
+    proc = subprocess.run(
+        [sys.executable, "-m", "galah_tpu.analysis",
+         "--check", "suppressions", "--sarif", str(sarif_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    log = json.loads(sarif_path.read_text())
+    jsonschema.validate(log, _SARIF_SUBSET_SCHEMA)
+    assert log["version"] == "2.1.0"
+    assert log["runs"][0]["tool"]["driver"]["version"] == "0.1.0"
+
+
+def test_lint_cli_warm_ir_cache_hits(tmp_path):
+    """End-to-end cold-vs-warm: the second run must hit the per-file
+    IR cache for every scanned file (same tree, same content hashes).
+    The wall-clock acceptance (warm <= 60% of cold) is exercised by
+    scripts/lint_gate.sh --self-check, which times real runs."""
+    from galah_tpu.analysis import ir, load_sources, repo_root
+
+    cache_dir = str(tmp_path / "irc")
+    sources = load_sources(repo_root())
+    cold = ir.IRCache(cache_dir)
+    ir.build_program_ir(sources, cache=cold)
+    assert cold.misses == len(sources) and cold.hits == 0
+    warm = ir.IRCache(cache_dir)
+    ir.build_program_ir(sources, cache=warm)
+    assert warm.hits == len(sources) and warm.misses == 0
