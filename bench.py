@@ -895,7 +895,8 @@ def _run_fleet_stage(stages, errors):
             stages["fleet_vs_single_wall"] = round(fleet_s / single_s,
                                                    2)
             with open(report) as f:
-                fl = json.load(f).get("fleet") or {}
+                rep = json.load(f)
+            fl = rep.get("fleet") or {}
             if isinstance(fl.get("merge_wall_s"), (int, float)):
                 stages["fleet_merge_wall_s"] = round(
                     fl["merge_wall_s"], 3)
@@ -907,6 +908,32 @@ def _run_fleet_stage(stages, errors):
                     obs.metrics.gauge(
                         f"workload.fleet_{k}",
                         help=hlp).set(float(fl[k]))
+            # Fleet critical path (v9 fleet_rollup): flatten the blame
+            # decomposition into bench gauges so the driver artifact —
+            # and the report --diff between sessions — carries where
+            # the fleet wall went (scheduler vs compute vs straggler
+            # wait vs merge), not just its total.
+            ru = rep.get("fleet_rollup") or {}
+            if isinstance(ru.get("fleet_wall_s"), (int, float)):
+                obs.metrics.gauge(
+                    "bench.fleet_wall_s",
+                    unit="s", help="Fleet bench wall from the rollup"
+                ).set(float(ru["fleet_wall_s"]))
+            for comp, c in sorted((ru.get("components") or {}).items()):
+                if not isinstance(c, dict):
+                    continue
+                if isinstance(c.get("blame_s"), (int, float)):
+                    obs.metrics.gauge(
+                        f"bench.fleet_{comp}_blame_s", unit="s",
+                        help=f"Fleet wall blamed on {comp}"
+                    ).set(float(c["blame_s"]))
+                if isinstance(c.get("share"), (int, float)):
+                    obs.metrics.gauge(
+                        f"bench.fleet_{comp}_share",
+                        help=f"Share of the fleet wall blamed on "
+                             f"{comp}").set(float(c["share"]))
+            if ru.get("bottleneck"):
+                stages["fleet_bottleneck"] = ru["bottleneck"]
         finally:
             shutil.rmtree(work, ignore_errors=True)
     except Exception as e:  # noqa: BLE001

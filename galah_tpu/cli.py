@@ -296,7 +296,9 @@ def build_parser() -> argparse.ArgumentParser:
                     "`analyze` recomputes the critical path from a "
                     "report and prints per-stage blame shares that sum "
                     "to the end-to-end wall "
-                    "(docs/observability.md)")
+                    "(docs/observability.md). Exit codes: 0 analysis "
+                    "printed, 1 unreadable report or no flow "
+                    "telemetry, 2 usage error")
     _add_verbosity(fl)
     flsub = fl.add_subparsers(dest="flow_action")
     fla = flsub.add_parser(
@@ -310,22 +312,33 @@ def build_parser() -> argparse.ArgumentParser:
                           "instead of the rendered table")
     tp = sub.add_parser(
         "top",
-        help="Live pipeline view from a run's heartbeat.jsonl",
+        help="Live pipeline view from a run's heartbeat.jsonl (or a "
+             "whole fleet dir)",
         description="Render the newest record of the heartbeat file a "
                     "run with GALAH_OBS_HEARTBEAT_S set writes beside "
                     "its run report: per-stage occupancy bars, queue "
-                    "depths, and item throughput. Safe against a run "
-                    "killed mid-write — a torn tail line is skipped, "
-                    "never an error (docs/observability.md)")
+                    "depths, and item throughput. Pointed at a fleet "
+                    "dir (auto-detected from fleet_plan.json / "
+                    "fleet_events.jsonl) it renders the per-shard "
+                    "grid — state, attempt chain, beat age, occupancy, "
+                    "rss — plus the scheduler event tail. Safe against "
+                    "a run killed mid-write — a torn tail line is "
+                    "skipped, never an error (docs/observability.md). "
+                    "Exit codes: 0 rendered, 1 no heartbeat/fleet "
+                    "data, 2 usage error")
     _add_verbosity(tp)
     tp.add_argument("directory", metavar="DIR",
-                    help="Run artifact directory (or a heartbeat.jsonl "
-                         "path directly)")
+                    help="Run artifact directory, a heartbeat.jsonl "
+                         "path directly, or a fleet dir")
     tp.add_argument("--follow", action="store_true",
                     help="Keep refreshing until interrupted")
     tp.add_argument("--interval", type=float, default=2.0,
                     help="Refresh period in seconds with --follow "
                          "(default: 2.0)")
+    tp.add_argument("--json", action="store_true",
+                    help="Emit the latest beat (or, for a fleet dir, "
+                         "the fleet grid) as JSON instead of the "
+                         "rendered page")
     ix = sub.add_parser(
         "index",
         help="Build and incrementally maintain a persistent versioned "
@@ -478,6 +491,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="Render a fleet directory's shard/event/heartbeat state "
              "(jax-free; usable while a fleet is live)")
     fts.add_argument("fleet_dir", help="Fleet working directory")
+    fta = ftsub.add_parser(
+        "analyze",
+        help="Cross-shard critical path of a fleet dir: blame table "
+             "(scheduler/compute/straggler/merge) summing to the "
+             "fleet wall, and the named bottleneck (jax-free)",
+        description="Aggregate fleet_events.jsonl + per-shard run "
+                    "reports/heartbeats into the fleet_rollup blame "
+                    "table, write fleet_report.json beside the plan, "
+                    "and name the bottleneck. Tolerates torn tails, "
+                    "shards missing mid-write, and v6-v8 shard "
+                    "reports. Exit codes: 0 rollup printed, 1 "
+                    "rollup-impossible dir (no event log), 2 usage "
+                    "error")
+    fta.add_argument("fleet_dir", help="Fleet working directory")
+    fta.add_argument("--json", action="store_true",
+                     help="Emit the rollup as JSON instead of the "
+                          "blame table")
+    fta.add_argument("--no-report", action="store_true",
+                     help="Skip writing fleet_report.json (print "
+                          "only)")
     parser._subcommand_parsers = {"cluster": c, "cluster-validate": v,
                                   "dist": dd, "lint": li, "report": rp,
                                   "perf": pf, "flow": fl, "top": tp,
@@ -789,7 +822,16 @@ def run_fleet(args) -> int:
     report_path = (getattr(args, "run_report", None)
                    or env_value("GALAH_OBS_REPORT"))
     obs.install_crash_hooks()
-    obs.heartbeat.maybe_start(report_path)
+    obs.heartbeat.maybe_start(report_path, role="scheduler")
+    # Every heartbeat tick's OpenMetrics page carries the live
+    # cross-shard rollup when the exporter flag is set (best-effort:
+    # a not-yet-rollable dir just omits the fleet series).
+    from galah_tpu.obs import fleet_view
+    from galah_tpu.obs import openmetrics as obs_openmetrics
+
+    fleet_dir = args.fleet_dir
+    obs_openmetrics.set_rollup_provider(
+        lambda: fleet_view.rollup(fleet_dir))
     try:
         return _run_fleet_inner(args)
     finally:
@@ -841,7 +883,7 @@ def _run_fleet_inner(args) -> int:
     from galah_tpu.config import env_value
     from galah_tpu.fleet import merge as fleet_merge
     from galah_tpu.fleet import plan as fleet_plan
-    from galah_tpu.fleet.scheduler import FleetScheduler
+    from galah_tpu.fleet.scheduler import FleetScheduler, append_stamp
     from galah_tpu.genome_inputs import parse_genome_inputs
     from galah_tpu.io import atomic, diskcache
     from galah_tpu.obs import events
@@ -982,6 +1024,11 @@ def _run_fleet_inner(args) -> int:
                                      clusterer.preclusterer, ani)
     snap["merge_wall_s"] = round(_time.monotonic() - merge_t0, 6)
     snap["n_genomes"] = len(genomes)
+    # rollup-ready stamp: fleet_view charges this window to merge
+    # blame; appended to the event log (not only the report) so
+    # `fleet analyze` works on dirs whose report never landed
+    append_stamp(fleet_dir, "fleet-merge-done",
+                 wall_s=snap["merge_wall_s"])
     fleet_pkg.set_snapshot(snap)
     logger.info("Found %d genome clusters", len(clusters))
 
@@ -1005,6 +1052,42 @@ def run_fleet_status(args) -> int:
     from galah_tpu.fleet.scheduler import render_status
 
     sys.stdout.write(render_status(args.fleet_dir))
+    return 0
+
+
+def run_fleet_analyze(args) -> int:
+    """`galah-tpu fleet analyze`: cross-shard critical path of a fleet
+    dir — blame table summing to the fleet wall, fleet_report.json
+    beside the plan, and the named bottleneck. Pure file I/O (jax-free,
+    runs against live and half-written fleet dirs alike)."""
+    import json as _json
+    import time as _time
+
+    from galah_tpu.obs import fleet_view
+
+    # wall-clock stamp for the report header, not a duration measure
+    started_at = _time.time()  # galah-lint: ignore[GL701]
+    ru = fleet_view.rollup(args.fleet_dir)
+    if ru is None:
+        logger.error(
+            "%s: rollup-impossible — no fleet event log (run "
+            "`galah-tpu fleet run --fleet-dir %s` first)",
+            args.fleet_dir, args.fleet_dir)
+        return 1
+    if not getattr(args, "no_report", False):
+        try:
+            path = fleet_view.write_fleet_report(
+                args.fleet_dir, ru, argv=sys.argv,
+                started_at=started_at)
+            logger.info("Wrote %s", path)
+        except Exception:  # rendering still succeeds without the file
+            logger.warning("fleet_report.json write failed",
+                           exc_info=True)
+    if getattr(args, "json", False):
+        print(_json.dumps(ru, indent=1, sort_keys=True))
+        return 0
+    for line in fleet_view.render_rollup(ru):
+        print(line)
     return 0
 
 
@@ -1197,18 +1280,43 @@ def run_flow_cmd(args) -> int:
 
 def run_top_cmd(args) -> int:
     """`galah-tpu top <dir>`: render the newest heartbeat of a live
-    (or finished) run. Pure file I/O: never touches jax, tolerates a
-    torn tail line from a run killed mid-append."""
+    (or finished) run — or, for a fleet dir (auto-detected from the
+    plan/event log), the per-shard fleet grid. Pure file I/O: never
+    touches jax, tolerates a torn tail line from a run killed
+    mid-append. Exit codes: 0 rendered, 1 no data."""
+    import json as _json
+
+    from galah_tpu.obs import fleet_view
     from galah_tpu.obs import heartbeat as heartbeat_mod
 
     follow = bool(getattr(args, "follow", False))
+    as_json = bool(getattr(args, "json", False))
     interval = max(float(getattr(args, "interval", 2.0) or 2.0), 0.1)
+    fleet_mode = (os.path.isdir(args.directory)
+                  and fleet_view.is_fleet_dir(args.directory))
     while True:
-        records, _torn = heartbeat_mod.load(args.directory)
-        sys.stdout.write(heartbeat_mod.render_latest(args.directory))
+        if fleet_mode:
+            grid = fleet_view.fleet_grid(args.directory)
+            ok = bool(grid and (grid["shards"] or grid["events"]))
+            if as_json:
+                sys.stdout.write(_json.dumps(
+                    grid or {}, indent=1, sort_keys=True) + "\n")
+            else:
+                sys.stdout.write(fleet_view.render_fleet_grid(
+                    grid or {"fleet_dir": args.directory}))
+        else:
+            records, _torn = heartbeat_mod.load(args.directory)
+            ok = bool(records)
+            if as_json:
+                latest = records[-1] if records else None
+                sys.stdout.write(_json.dumps(
+                    latest, indent=1, sort_keys=True) + "\n")
+            else:
+                sys.stdout.write(
+                    heartbeat_mod.render_latest(args.directory))
         sys.stdout.flush()
         if not follow:
-            return 0 if records else 1
+            return 0 if ok else 1
         try:
             time.sleep(interval)
         except KeyboardInterrupt:
@@ -1455,10 +1563,13 @@ def main(argv=None) -> int:
         return run_top_cmd(args)
     if args.subcommand == "fleet" and \
             getattr(args, "fleet_action", None) != "run":
-        # `fleet status` reads plan/events/heartbeats — jax-free, so it
-        # works beside a live fleet on accelerator-less hosts too.
+        # `fleet status`/`fleet analyze` read plan/events/heartbeats/
+        # shard reports — jax-free, so they work beside a live fleet
+        # on accelerator-less hosts too.
         if getattr(args, "fleet_action", None) == "status":
             return run_fleet_status(args)
+        if getattr(args, "fleet_action", None) == "analyze":
+            return run_fleet_analyze(args)
         parser._subcommand_parsers["fleet"].print_help()
         return 1
     platform = (getattr(args, "platform", None)

@@ -284,6 +284,14 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "appends counters/gauges/queue-depth/occupancy to "
               "heartbeat.jsonl beside the run report, rendered live "
               "by `galah-tpu top <dir>`. 0 (the default) disables it"),
+    Flag("GALAH_OBS_OPENMETRICS", section="observability",
+         help="Render the metrics registry — and, in a fleet run, the "
+              "cross-shard blame rollup — to this path in Prometheus "
+              "text exposition format on every heartbeat tick "
+              "(galah_tpu/obs/openmetrics.py; atomically swapped, so "
+              "a node-exporter textfile collector never reads a torn "
+              "page). Needs GALAH_OBS_HEARTBEAT_S > 0 to tick; unset "
+              "disables the exporter"),
     Flag("GALAH_OBS_LEDGER", section="observability",
          help="Append one entry per finalized run to this cross-run "
               "perf ledger (JSONL, keyed by backend/topology/"
@@ -349,6 +357,15 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
          help="GALAH_OBS_HEARTBEAT_S value injected into fleet "
               "workers (their liveness signal); 0 disables worker "
               "heartbeats AND staleness detection"),
+    Flag("GALAH_TPU_FLEET_WORKER", section="resilience",
+         help="Set BY the fleet supervisor in every worker "
+              "subprocess's environment (value: the fleet dir's "
+              "absolute path) — the orphan-adoption stamp it matches "
+              "against /proc/<pid>/environ, and the marker the "
+              "telemetry layer uses to brand worker heartbeats and "
+              "shard ledger entries. Never set this by hand: a "
+              "process carrying the stamp is killable by any "
+              "scheduler supervising that fleet dir"),
 ) + _retry_family(
     "GALAH_RETRY", "Device-dispatch retry policy"
 ) + _retry_family(

@@ -67,6 +67,18 @@ def _wall() -> float:
     return time.time()  # galah-lint: ignore[GL701] event timestamp
 
 
+def append_stamp(fleet_dir: str, ev: str, **fields: Any) -> None:
+    """Append one timestamped event to the fleet event log.
+
+    Shared by the scheduler and by post-supervise phases (merge,
+    finalize) in the CLI so the rollup aggregator (obs/fleet_view)
+    can reconstruct the fleet wall from a single ordered log."""
+    rec: Dict[str, Any] = {"ev": ev, "ts": _wall()}
+    rec.update(fields)
+    atomic.append_jsonl(plan_mod.events_path(fleet_dir), rec,
+                        site="fleet-events")
+
+
 def shard_root(fleet_dir: str, shard_id: int) -> str:
     return plan_mod.shard_dir(fleet_dir, shard_id)
 
@@ -162,10 +174,7 @@ class FleetScheduler:
     # ---------------------------------------------------------- events
 
     def _append_event(self, ev: str, **fields: Any) -> None:
-        rec = {"ev": ev, "ts": _wall()}
-        rec.update(fields)
-        atomic.append_jsonl(plan_mod.events_path(self.fleet_dir), rec,
-                            site="fleet-events")
+        append_stamp(self.fleet_dir, ev, **fields)
 
     def _replay_events(self) -> List[Dict[str, Any]]:
         records, torn = atomic.read_jsonl(
@@ -324,6 +333,12 @@ class FleetScheduler:
             backoff = 0.0
         rt.next_eligible_mono = time.monotonic() + backoff
         self.retry_spend_s += backoff
+        if backoff > 0:
+            # rollup-ready stamp: fleet_view charges this window to
+            # scheduler blame (backoff bucket) without re-deriving the
+            # retry policy from env
+            self._append_event("shard-backoff", shard=sid,
+                               backoff_s=round(backoff, 6))
         rt.status = "pending"
         logger.warning("fleet: shard %d preempted (%s), reassigning",
                        sid, reason)
@@ -464,6 +479,14 @@ class FleetScheduler:
                 if rt.status == "running":
                     self._kill_group(rt)
         self._update_gauges()
+        # rollup-ready stamp: marks the supervise-phase end so the
+        # aggregator can split fleet wall into supervise vs merge even
+        # when the final run report never lands (scheduler killed later)
+        self._append_event(
+            "fleet-supervise-done",
+            shards_done=sum(1 for rt in self.shards
+                            if rt.status == "done"),
+            retry_spend_s=round(self.retry_spend_s, 6))
         return self.snapshot()
 
     def snapshot(self) -> Dict[str, Any]:
@@ -477,6 +500,7 @@ class FleetScheduler:
             "preemptions": list(rt.preemptions),
         } for rt in self.shards]
         return {
+            "fleet_dir": os.path.abspath(self.fleet_dir),
             "n_shards": len(self.shards),
             "workers": self.workers,
             "shards_done": sum(1 for s in shards

@@ -51,6 +51,11 @@ def reset_run() -> None:
     profile.reset()
     flow.reset()
     heartbeat.reset()
+    # OpenMetrics exporter state (the fleet-rollup provider is bound
+    # to one run's fleet dir).
+    from galah_tpu.obs import openmetrics
+
+    openmetrics.reset()
     # Index-operation snapshot (stdlib-only package, safe to import
     # here): one run = at most one index op's summary in the report.
     from galah_tpu import index as index_pkg
@@ -60,6 +65,23 @@ def reset_run() -> None:
     from galah_tpu import fleet as fleet_pkg
 
     fleet_pkg.reset()
+
+
+def _shard_context(report_path: Optional[str]) -> Optional[int]:
+    """The fleet shard id this process is finalizing for, or None.
+
+    A fleet worker subprocess carries the scheduler's
+    GALAH_TPU_FLEET_WORKER env stamp and writes its report under
+    ``shards/shard_NNN/``; both must agree before we brand the ledger
+    entry — a bystander run that merely reports into a shard-shaped
+    path keeps the plain key."""
+    import os
+    import re
+
+    if not os.environ.get("GALAH_TPU_FLEET_WORKER"):
+        return None
+    m = re.search(r"shard_(\d+)", os.path.abspath(report_path or ""))
+    return int(m.group(1)) if m else None
 
 
 def finalize(subcommand: str,
@@ -96,7 +118,8 @@ def finalize(subcommand: str,
         if ledger_path:
             from galah_tpu.obs import ledger as ledger_mod
 
-            ledger_mod.record_report(ledger_path, out, subcommand)
+            ledger_mod.record_report(ledger_path, out, subcommand,
+                                     shard=_shard_context(report_path))
     except Exception:
         logger.warning("run report assembly failed", exc_info=True)
     finally:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import threading
 import time
 from typing import Dict, Optional
@@ -34,6 +35,38 @@ from typing import Dict, Optional
 logger = logging.getLogger(__name__)
 
 HEARTBEAT_FILENAME = "heartbeat.jsonl"
+
+_SHARD_DIR_RE = re.compile(r"shard_(\d+)$")
+
+
+def _infer_role_shard(directory: str) -> tuple:
+    """(role, shard) stamps for beats written into ``directory``.
+
+    A fleet worker subprocess carries the scheduler's
+    GALAH_TPU_FLEET_WORKER env stamp and writes its heartbeat inside
+    ``shards/shard_NNN/`` — both are recoverable here without any new
+    plumbing. Single-process runs get (None, None): beats stay
+    unstamped, and old logs read clean."""
+    role = ("worker" if os.environ.get("GALAH_TPU_FLEET_WORKER")
+            else None)
+    shard = None
+    m = _SHARD_DIR_RE.search(os.path.abspath(directory or "."))
+    if m:
+        shard = int(m.group(1))
+    return role, shard
+
+
+def _rss_mb() -> Optional[float]:
+    """Resident set size in MB from /proc/self/status (stdlib-only;
+    None on platforms without procfs)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
 
 # Concurrency contract (GL8xx lint + GalahSan runtime). The module
 # global GLOBAL is unguarded by the same lifecycle argument as
@@ -50,10 +83,16 @@ LOCK_ORDER = ["Heartbeat._lock"]
 class Heartbeat:
     """One run's heartbeat writer thread."""
 
-    def __init__(self, directory: str, period_s: float) -> None:
+    def __init__(self, directory: str, period_s: float,
+                 role: Optional[str] = None) -> None:
         os.makedirs(directory or ".", exist_ok=True)
         self.path = os.path.join(directory or ".", HEARTBEAT_FILENAME)
         self.period_s = max(0.05, float(period_s))
+        # role/shard stamps (set once here, read-only afterwards):
+        # explicit role wins (the fleet scheduler passes "scheduler");
+        # otherwise inferred from the worker env stamp + shard dir
+        inferred_role, self.shard = _infer_role_shard(directory)
+        self.role = role or inferred_role
         self._lock = threading.Lock()
         self._stop_evt = threading.Event()
         self._t0 = time.monotonic()
@@ -102,7 +141,7 @@ class Heartbeat:
         fsnap = obs_flow.snapshot()
         flow_items = {s: st.get("items", 0)
                       for s, st in (fsnap.get("stages") or {}).items()}
-        return {
+        rec = {
             "ts": time.time(),
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "occupancy": occupancy,
@@ -111,6 +150,14 @@ class Heartbeat:
             "queue_depths": obs_flow.queue_depths(),
             "flow_items": flow_items,
         }
+        if self.role is not None:
+            rec["role"] = self.role
+        if self.shard is not None:
+            rec["shard"] = self.shard
+        rss = _rss_mb()
+        if rss is not None:
+            rec["rss_mb"] = rss
+        return rec
 
     def beat(self) -> None:
         """Sample + durably append one record (also the final-flush
@@ -132,6 +179,14 @@ class Heartbeat:
                     acc[3] = v
         atomic.append_jsonl(self.path, rec,
                             site="io.atomic.append[heartbeat]")
+        # OpenMetrics textfile tick rides the beat cadence: one
+        # atomically-swapped .prom per beat when the flag is set
+        try:
+            from galah_tpu.obs import openmetrics as obs_openmetrics
+
+            obs_openmetrics.maybe_export()
+        except Exception:  # telemetry never takes down the run
+            logger.debug("openmetrics export failed", exc_info=True)
 
     def stop(self, flush: bool = True, join_timeout: float = 5.0) -> None:
         """Stop the thread; with ``flush`` write one final beat (once,
@@ -170,11 +225,12 @@ class Heartbeat:
 GLOBAL: Optional[Heartbeat] = None
 
 
-def start(directory: str, period_s: float) -> Heartbeat:
+def start(directory: str, period_s: float,
+          role: Optional[str] = None) -> Heartbeat:
     global GLOBAL
     if GLOBAL is not None:
         GLOBAL.stop(flush=False)
-    GLOBAL = Heartbeat(directory, period_s)
+    GLOBAL = Heartbeat(directory, period_s, role=role)
     GLOBAL.start()
     logger.info("Heartbeat every %.3gs -> %s (watch with "
                 "`galah-tpu top %s`)", GLOBAL.period_s, GLOBAL.path,
@@ -182,7 +238,8 @@ def start(directory: str, period_s: float) -> Heartbeat:
     return GLOBAL
 
 
-def maybe_start(report_path: Optional[str]) -> Optional[Heartbeat]:
+def maybe_start(report_path: Optional[str],
+                role: Optional[str] = None) -> Optional[Heartbeat]:
     """CLI lifecycle hook: start next to the run-report sink when
     GALAH_OBS_HEARTBEAT_S > 0 (the flag's default keeps it off)."""
     try:
@@ -195,7 +252,7 @@ def maybe_start(report_path: Optional[str]) -> Optional[Heartbeat]:
     if period <= 0:
         return None
     directory = os.path.dirname(report_path) if report_path else "."
-    return start(directory or ".", period)
+    return start(directory or ".", period, role=role)
 
 
 def stop(flush: bool = True) -> None:
@@ -264,9 +321,16 @@ def render_latest(directory: str) -> str:
                 "GALAH_OBS_HEARTBEAT_S=<seconds>)\n")
     rec = records[-1]
     age = max(0.0, time.time() - float(rec.get("ts") or 0.0))
+    who = ""
+    if rec.get("role") is not None:
+        who = f"  role {rec['role']}"
+        if rec.get("shard") is not None:
+            who += f" (shard {rec['shard']})"
+    rss = (f"  rss {rec['rss_mb']:.0f}MB"
+           if isinstance(rec.get("rss_mb"), (int, float)) else "")
     lines = [f"heartbeat {path}",
              f"  beat {rec.get('beat')}  age {age:.1f}s  uptime "
-             f"{rec.get('uptime_s')}s  ({len(records)} beat(s)"
+             f"{rec.get('uptime_s')}s{who}{rss}  ({len(records)} beat(s)"
              + (f", {torn} torn" if torn else "") + ")"]
     occ = rec.get("occupancy") or {}
     if occ:

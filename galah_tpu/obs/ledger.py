@@ -264,31 +264,44 @@ def metrics_of_report(report: dict) -> Dict[str, float]:
 
 def entry_from_report(report: dict, source: str,
                       ts: Optional[float] = None,
-                      sha: Optional[str] = None) -> Dict[str, Any]:
-    """One ledger entry from an assembled run report dict."""
+                      sha: Optional[str] = None,
+                      shard: Optional[int] = None) -> Dict[str, Any]:
+    """One ledger entry from an assembled run report dict.
+
+    ``shard`` is the fleet-worker shard id: a worker subprocess runs
+    the same (backend, workload, strategy) configuration as a whole
+    single-process run but over a SUBSET of the genomes, so without a
+    shard key member its wall would land in — and poison — the e2e
+    noise band that ``perf check`` gates on. Shard entries get their
+    own key (and so their own band); non-fleet entries keep the exact
+    pre-shard key shape, so existing histories keep matching."""
     dev = report.get("device", {}) or {}
     kinds = {d.get("device_kind") for d in dev.get("devices") or []}
+    key: Dict[str, Any] = {
+        "backend": dev.get("backend"),
+        "device_kind": (sorted(kinds)[0] if kinds else None),
+        "n_devices": dev.get("device_count"),
+        "workload": workload_fingerprint(report),
+        "strategy": strategy_fingerprint(report),
+        "source": source,
+    }
+    if shard is not None:
+        key["shard"] = int(shard)
     return {
         "v": LEDGER_VERSION,
         "ts": float(ts if ts is not None else time.time()),
         "sha": sha if sha is not None else git_sha(),
-        "key": {
-            "backend": dev.get("backend"),
-            "device_kind": (sorted(kinds)[0] if kinds else None),
-            "n_devices": dev.get("device_count"),
-            "workload": workload_fingerprint(report),
-            "strategy": strategy_fingerprint(report),
-            "source": source,
-        },
+        "key": key,
         "metrics": metrics_of_report(report),
     }
 
 
-def record_report(path: str, report: dict, source: str) -> bool:
+def record_report(path: str, report: dict, source: str,
+                  shard: Optional[int] = None) -> bool:
     """Append `report` to the ledger at `path`; False (and a log line)
     on failure — feeding the ledger must never fail the run."""
     try:
-        append(path, entry_from_report(report, source))
+        append(path, entry_from_report(report, source, shard=shard))
         return True
     except Exception:
         logger.warning("perf ledger append failed", exc_info=True)

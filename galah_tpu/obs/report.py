@@ -27,7 +27,7 @@ logger = logging.getLogger(__name__)
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
                            "run_report.schema.json")
 # v8: lint.timings_s — per-checker-family wall seconds (additive)
-REPORT_VERSION = 8
+REPORT_VERSION = 9  # v9: fleet_rollup (cross-shard critical path)
 
 # disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
 _DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
@@ -206,7 +206,14 @@ def assemble(subcommand: str,
         fleet_snap = fleet_pkg.snapshot()
         if fleet_snap is not None:
             report["fleet"] = fleet_snap
-    except Exception:  # additive section (v7); never lose a report
+            fleet_dir = fleet_snap.get("fleet_dir")
+            if fleet_dir:
+                from galah_tpu.obs import fleet_view
+
+                ru = fleet_view.rollup(fleet_dir)
+                if ru is not None:
+                    report["fleet_rollup"] = ru
+    except Exception:  # additive sections (v7/v9); never lose a report
         logger.debug("fleet snapshot failed", exc_info=True)
     try:
         from galah_tpu.obs import flow as obs_flow
@@ -485,6 +492,11 @@ def render(report: dict) -> str:
                 f"[{sh.get('lo')}:{sh.get('hi')})  "
                 f"{sh.get('status')}  attempts={sh.get('attempts')}  "
                 f"chain={chain}")
+    rollup = report.get("fleet_rollup")
+    if rollup is not None:
+        from galah_tpu.obs import fleet_view
+
+        lines += [""] + fleet_view.render_rollup(rollup)
     lint = report.get("lint")
     if lint is not None:
         fams = ", ".join(f"{fam}={n}" for fam, n in
@@ -650,6 +662,30 @@ def diff(a: dict, b: dict, label_a: str = "A",
                     "preemptions", "reassignments"):
             va, vb = int(fla.get(key, 0)), int(flb.get(key, 0))
             lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
+
+    # fleet rollup drift — additive v9 section, .get throughout;
+    # tolerates one side being an older (v6-v8) report with no rollup
+    ra, rb = a.get("fleet_rollup"), b.get("fleet_rollup")
+    if ra is not None or rb is not None:
+        ra, rb = ra or {}, rb or {}
+        lines += ["", "fleet rollup drift:"]
+        wa = float(ra.get("fleet_wall_s") or 0.0)
+        wb = float(rb.get("fleet_wall_s") or 0.0)
+        lines.append(f"  fleet_wall_s: {wa:.2f} -> {wb:.2f} "
+                     f"({wb - wa:+.2f}s)")
+        bna = ra.get("bottleneck")
+        bnb = rb.get("bottleneck")
+        lines.append(f"  bottleneck: {bna} -> {bnb}"
+                     + ("  [MIGRATED]" if bna != bnb else ""))
+        ca_ = ra.get("components") or {}
+        cb_ = rb.get("components") or {}
+        for comp in sorted(set(ca_) | set(cb_)):
+            va = int(round(100 * ((ca_.get(comp) or {}).get("share")
+                                  or 0.0)))
+            vb = int(round(100 * ((cb_.get(comp) or {}).get("share")
+                                  or 0.0)))
+            lines.append(
+                f"  share[{comp}]: {va}% -> {vb}% ({vb - va:+d}%)")
 
     # flow drift — additive v6 section, .get throughout. A migrated
     # bottleneck is THE regression signal the flow layer exists for.

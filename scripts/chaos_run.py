@@ -651,6 +651,38 @@ def check_fleet_report(report_path: str, n_shards: int
     return None
 
 
+def check_fleet_analyze(fleet_dir: str) -> Optional[str]:
+    """``galah-tpu fleet analyze --json`` must succeed on the
+    completed fleet dir — even when the scheduler itself was killed
+    mid-run, the event log alone must support a rollup — and its
+    blame decomposition must conserve the fleet wall: component
+    blame_s summing to fleet_wall_s within 1%, with a named
+    bottleneck."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "galah_tpu.cli", "fleet", "analyze",
+         "--json", "--no-report", fleet_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=120)
+    if proc.returncode != 0:
+        return (f"fleet analyze exited {proc.returncode}: "
+                + proc.stderr.decode(errors="replace")[-1000:])
+    try:
+        ru = json.loads(proc.stdout)
+    except Exception as exc:
+        return f"fleet analyze --json emitted unparseable JSON: {exc}"
+    wall = ru.get("fleet_wall_s")
+    comps = ru.get("components", {})
+    if not isinstance(wall, (int, float)) or wall <= 0:
+        return f"fleet analyze rollup has no wall: {wall!r}"
+    blame_sum = sum(c.get("blame_s", 0.0) for c in comps.values()
+                    if isinstance(c, dict))
+    if abs(blame_sum - wall) > 0.01 * wall:
+        return (f"fleet blame does not conserve the wall: "
+                f"sum {blame_sum:.3f}s vs wall {wall:.3f}s")
+    if not ru.get("bottleneck"):
+        return "fleet analyze named no bottleneck"
+    return None
+
+
 def run_fleet_iteration(genomes: List[str], reference: bytes,
                         workdir: str, mode: str, seed: int,
                         cache_env: Dict[str, str], shards: int = 3
@@ -769,6 +801,9 @@ def run_fleet_iteration(genomes: List[str], reference: bytes,
         return False, "\n".join(
             log + [f"{mode}: corrupt fleet artifacts:"] + problems)
     err = check_fleet_report(report, n_shards=shards)
+    if err:
+        return False, "\n".join(log + [f"{mode}: {err}"])
+    err = check_fleet_analyze(fleet_dir)
     if err:
         return False, "\n".join(log + [f"{mode}: {err}"])
     return True, "\n".join(log)

@@ -167,6 +167,15 @@ run_stage chaos_overlap 900 env JAX_PLATFORMS=cpu \
 run_stage chaos_fleet 900 env JAX_PLATFORMS=cpu \
   python -u scripts/chaos_run.py --iterations 10 --seed 3 \
   --workload fleet
+# Fleet observability plane (host CPU, no tunnel use): one small
+# sharded run with the OpenMetrics textfile exporter on, then `fleet
+# analyze` (blame table conserving the fleet wall), `top --json` (the
+# per-shard grid), and a Prometheus-parser check of the exported
+# .prom (docs/observability.md). Soft-warn: a telemetry regression is
+# reported in the capture without discarding the hardware stages.
+run_stage fleet_observe 600 bash -c \
+  "python -u scripts/fleet_observe.py \
+   || echo 'fleet_observe: WARN fleet observability gate failed (soft)'"
 run_stage test_tpu_hw 2400 env GALAH_RUN_SLOW=1 \
   python -u -m pytest tests/test_tpu_hw.py -q
 run_stage amortized 1800 python -u scripts/bench_amortized.py

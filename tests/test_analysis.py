@@ -584,7 +584,7 @@ def test_lint_run_report_carries_summary(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(report_path.read_text())
-    assert report["version"] == 8
+    assert report["version"] == 9
     assert report["run"]["subcommand"] == "lint"
     assert set(report["lint"]) == {"errors", "warnings", "notes",
                                    "suppressed", "by_family",
