@@ -778,6 +778,50 @@ def _run_ingest_variants_stage(stages, errors):
         errors.append(f"ingest_variants: {type(e).__name__}: {e}")
 
 
+def _run_ingest_tiered_stage(stages, errors):
+    """Out-of-core sketch tier vs all-resident in a subprocess
+    (scripts/bench_ingest_tiered.py): peak-RSS delta and ingest rate
+    at N in {1k, 20k, 100k} synthetic genomes, paged band walk vs the
+    resident matrix, pair-dict parity gated per rung. The headline
+    ``pagestore_*`` scalars flatten into stages so _finalize_obs
+    mirrors them into bench.pagestore_* gauges and the perf ledger
+    gates the RSS bound (paged/resident delta ratio; the tentpole's
+    acceptance is <= 1/8 at the 100k rung) and the paged ingest rate.
+    Self-budgeting script, subprocess timeout, host-side work — as
+    real on the cpu-fallback branch as on the device one."""
+    _TIERED_COST = 480
+    if not _admit(_TIERED_COST, "ingest_tiered", errors):
+        return
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "scripts", "bench_ingest_tiered.py"),
+             "--budget", str(_TIERED_COST - 60)],
+            capture_output=True, text=True,
+            timeout=_TIERED_COST, cwd=here)
+        data = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("TIERED_JSON "):
+                data = json.loads(line[len("TIERED_JSON "):])
+        if data is None:
+            raise RuntimeError(
+                f"rc={proc.returncode}: {proc.stderr[-400:]}")
+        stages["ingest_tiered"] = data
+        for k in ("pagestore_delta_rss_ratio",
+                  "pagestore_paged_genomes_per_sec",
+                  "pagestore_resident_genomes_per_sec",
+                  "pagestore_page_ins", "pagestore_page_outs",
+                  "pagestore_parity_ok"):
+            if isinstance(data.get(k), (int, float)):
+                stages[k] = data[k]
+        if not data.get("parity_ok", False):
+            errors.append("ingest_tiered: paged pair dict diverged "
+                          "from the all-resident pass")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"ingest_tiered: {type(e).__name__}: {e}")
+
+
 def _run_index_stage(stages, errors):
     """Incremental-index service numbers in a subprocess
     (scripts/bench_index.py): build the persistent index once over
@@ -1176,6 +1220,8 @@ def main():
         # Ingest->sketch is host-side work: the matrix is as real on
         # the cpu-fallback branch as on the device one.
         _run_ingest_variants_stage(stages, errors)
+        # The memory-tier comparison is pure host/RSS measurement.
+        _run_ingest_tiered_stage(stages, errors)
         # The index service is specified against CPU latency targets,
         # so the fallback branch runs the real measurement too.
         _run_index_stage(stages, errors)
@@ -1298,6 +1344,10 @@ def main():
     # 4f. Storage-bound ingest->sketch matrix: streamed pipeline vs
     # the serial-prologue baseline over a >= 1 Gbp corpus.
     _run_ingest_variants_stage(stages, errors)
+
+    # 4f'. Out-of-core sketch tier vs all-resident: peak-RSS ratio
+    # and ingest rate per rung, pair-dict parity gated.
+    _run_ingest_tiered_stage(stages, errors)
 
     # 4g. Incremental-index service: build-once, insert-10%,
     # warm query-latency sweep (p50 target < 50 ms on CPU).

@@ -31,7 +31,11 @@ Checks
          creates the same edge).
   GL803  a cycle in the observed acquisition graph — including the
          length-1 cycle of re-acquiring a held non-reentrant Lock
-         (self-deadlock).
+         (self-deadlock). A lock constructed as ``threading.RLock()``
+         is reentrant by contract, so its length-1 cycle is exempt
+         (the under-lock-helper idiom: a public method holds the lock
+         and calls a ``*_locked`` helper that re-enters it); longer
+         cycles still report — reentrancy never excuses an AB/BA.
   GL804  a thread-pool ``submit`` or ``threading.Thread(target=...)``
          whose callable is not adopt-wrapped: worker threads must
          capture ``timing.stage_token()`` in the spawning thread and
@@ -128,6 +132,9 @@ class _Module:
         self.globals_assigned: Set[str] = set()
         self.import_mods: Dict[str, str] = {}   # alias -> module path
         self.import_funcs: Dict[str, Tuple[str, str]] = {}
+        # lock names bound to threading.RLock() — reentrant, so the
+        # GL803 length-1 self-cycle does not apply to them
+        self.reentrant_locks: Set[str] = set()
         self._scan()
 
     @property
@@ -179,6 +186,31 @@ class _Module:
                         alias, _dotted_to_path(child))
                     self.import_funcs.setdefault(
                         alias, (_dotted_to_path(mod), a.name))
+        self._scan_reentrant()
+
+    def _scan_reentrant(self) -> None:
+        def is_rlock(value: ast.AST) -> bool:
+            return (isinstance(value, ast.Call)
+                    and dotted_name(value.func).rsplit(".", 1)[-1]
+                    == "RLock")
+
+        for cname, cnode in self.classes.items():
+            for n in ast.walk(cnode):
+                if not (isinstance(n, ast.Assign)
+                        and is_rlock(n.value)):
+                    continue
+                for t in n.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        self.reentrant_locks.add(f"{cname}.{t.attr}")
+        for node in self.src.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and is_rlock(node.value)):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.reentrant_locks.add(t.id)
 
     # -- canonicalization --------------------------------------------
 
@@ -401,7 +433,9 @@ def check_concurrency(sources: Dict[str, SourceFile]) -> List[Finding]:
         findings.extend(_walk_function(info, registry, edges))
 
     findings.extend(_order_violations(edges, declared_order))
-    findings.extend(_cycles(edges))
+    reentrant = {(m.path, name) for m in annotated
+                 for name in m.reentrant_locks}
+    findings.extend(_cycles(edges, reentrant))
 
     for m in annotated:
         findings.extend(_check_adoption(m))
@@ -599,11 +633,17 @@ def _order_violations(
 
 def _cycles(
     edges: Dict[Tuple[LockId, LockId], Tuple[str, int, str]],
+    reentrant: Optional[Set[LockId]] = None,
 ) -> List[Finding]:
     """DFS cycle detection over the observed acquisition graph; each
-    cycle reported once, anchored at its lexically first edge."""
+    cycle reported once, anchored at its lexically first edge. A
+    length-1 cycle on a lock in `reentrant` (threading.RLock) is the
+    sanctioned under-lock-helper idiom, not a self-deadlock."""
+    reentrant = reentrant or set()
     graph: Dict[LockId, List[LockId]] = {}
     for held, acquired in edges:
+        if held == acquired and held in reentrant:
+            continue
         graph.setdefault(held, []).append(acquired)
     out: List[Finding] = []
     seen_cycles: Set[Tuple[LockId, ...]] = set()

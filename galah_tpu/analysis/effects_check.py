@@ -22,6 +22,15 @@ Checks
           that parameter — directly (``list(p)``) or transitively
           (forwards it to a materializer). The direct-call case stays
           lexical GL1001.
+  GL1007  (interprocedural arm; the in-function cases stay lexical in
+          pipeline_check) a gathered band submatrix — a ``gather()``
+          / ``band_gather()`` value inside a ``PAGED_MODULES``
+          band-walk function — passed into a callee that retains its
+          parameter (stores it on ``self``/a container, directly or
+          through further forwarding). The retained reference pins
+          the band's backing pages past eviction, so the out-of-core
+          tier silently degrades to all-resident; the message carries
+          the GalahIR retention chain down to the storing statement.
   GL1104  a lock acquired as a bare ``.acquire()`` statement with no
           ``with`` block and no try/finally releasing the same
           receiver: any raise between acquire and release leaks the
@@ -141,6 +150,42 @@ def _check_stream_materialization(program: girt.ProgramIR,
                     symbol=producer))
 
 
+def _check_paged_retention(program: girt.ProgramIR,
+                           out: List[Finding]) -> None:
+    """GL1007 (interprocedural): a gathered band submatrix handed to
+    a callee that retains it — the helper indirection the lexical arm
+    in pipeline_check cannot see."""
+    for mod in program.modules.values():
+        names = pipeline_check.PAGED_MODULES.get(mod.path)
+        if not names:
+            continue
+        for qual in sorted(mod.functions):
+            if qual.split(".")[-1] not in names:
+                continue
+            fn = mod.functions[qual]
+            for cname, idx, line, producer in fn.gather_args:
+                callee = program.resolve(mod, qual, cname)
+                if callee is None:
+                    continue
+                param = program.retaining_param(callee, idx)
+                if param is None:
+                    continue
+                out.append(Finding(
+                    code="GL1007", severity=Severity.WARNING,
+                    path=mod.path, line=line,
+                    message=(f"band submatrix from {producer}() is "
+                             f"retained by {callee[1]}() ("
+                             + program.render_retention_chain(
+                                 callee, param)
+                             + "): the reference pins the band's "
+                             "backing pages past eviction and the "
+                             "paging schedule silently degrades to "
+                             "all-resident (docs/memory.md); reduce "
+                             "the band to its result instead of "
+                             "storing it"),
+                    symbol=producer))
+
+
 def _check_unsafe_acquires(program: girt.ProgramIR,
                            out: List[Finding]) -> None:
     """GL1104: bare acquire with no release on the raising path."""
@@ -209,6 +254,7 @@ def check_effects(sources: Dict[str, SourceFile],
     _check_device_round_sync(program, out)
     _check_durable_writes(program, out)
     _check_stream_materialization(program, out)
+    _check_paged_retention(program, out)
     _check_unsafe_acquires(program, out)
     _check_submit_adoption(program, out)
     return out

@@ -80,7 +80,7 @@ logger = logging.getLogger(__name__)
 
 #: Bump on ANY change to extraction or the serialized shape: the cache
 #: key includes it, so stale entries miss instead of lying.
-IR_VERSION = 1
+IR_VERSION = 2  # v2: retained_params + gather_args (GL1007)
 
 #: The effect lattice (a powerset over this alphabet; join = union).
 EFFECTS = ("host_sync", "device_dispatch", "fs_write", "lock_acquire",
@@ -116,6 +116,17 @@ STREAMING_PREFIX = "iter_"
 STREAMING_SUFFIX = "_streamed"
 STREAMING_NAMES = frozenset({"process_stream"})
 
+#: Last-component call names that gather a paged band submatrix (the
+#: GL1007 producer set; kept identical to pipeline_check.GATHER_NAMES
+#: so the interprocedural arm is an exact transitive extension of the
+#: lexical one).
+GATHER_LASTS = frozenset({"gather", "band_gather"})
+
+#: Receiver methods that retain their argument beyond the call (the
+#: GL1007 retention sink set: the value outlives the band iteration).
+RETAINER_METHODS = frozenset({"append", "add", "extend",
+                              "appendleft", "setdefault"})
+
 #: Global-state RNG (determinism_check's GL904 sets, minus seeded forms).
 RANDOM_GLOBAL_FNS = frozenset({
     "random", "randint", "randrange", "uniform", "choice", "choices",
@@ -147,6 +158,14 @@ def _is_streaming_name(name: str) -> bool:
     n = _last(name)
     return (n.startswith(STREAMING_PREFIX)
             or n.endswith(STREAMING_SUFFIX) or n in STREAMING_NAMES)
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The base identifier of an attribute/subscript chain
+    (``self.cache[k]`` -> ``self``), or None for computed bases."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
 
 
 def _literal_open_mode(node: ast.Call) -> Optional[str]:
@@ -208,6 +227,15 @@ class FuncIR:
     # [callee-name, arg-index, line, producer]: a streamed-producer
     # value passed positionally into a call (the GL1103 pass sites)
     stream_args: List[List] = dataclasses.field(default_factory=list)
+    # [param, line]: parameters this body stores beyond the call —
+    # `self.x = p` / `obj[k] = p` / `acc.append(p)` — the direct half
+    # of GL1007's retention query
+    retained_params: List[List] = \
+        dataclasses.field(default_factory=list)
+    # [callee-name, arg-index, line, producer]: a gathered band
+    # submatrix (gather()/band_gather() value) passed positionally
+    # into a call (the GL1007 pass sites)
+    gather_args: List[List] = dataclasses.field(default_factory=list)
     # body references timing.adopt/stage_token (the GL804/GL1105 mark)
     adopts: bool = False
     # decorator dotted names, outermost first (unwrapped for linking)
@@ -227,6 +255,8 @@ class FuncIR:
             "materialized_params": self.materialized_params,
             "forwarded_params": self.forwarded_params,
             "stream_args": self.stream_args,
+            "retained_params": self.retained_params,
+            "gather_args": self.gather_args,
             "adopts": self.adopts,
             "decorators": self.decorators,
             "unsafe_acquires": self.unsafe_acquires,
@@ -242,6 +272,9 @@ class FuncIR:
             materialized_params=list(raw["materialized_params"]),
             forwarded_params=[list(e) for e in raw["forwarded_params"]],
             stream_args=[list(e) for e in raw["stream_args"]],
+            retained_params=[list(e)
+                             for e in raw.get("retained_params", [])],
+            gather_args=[list(e) for e in raw.get("gather_args", [])],
             adopts=bool(raw["adopts"]),
             decorators=list(raw["decorators"]),
             unsafe_acquires=[list(e) for e in raw["unsafe_acquires"]],
@@ -427,16 +460,45 @@ class _Extractor:
         self._walk_body(node, fn, qual)
 
     def _walk_body(self, node: ast.AST, fn: FuncIR, qual: str) -> None:
-        # names bound to a streamed producer inside this body
+        # names bound to a streamed producer / a band gather inside
+        # this body
         bound_streams: Set[str] = set()
+        bound_gathers: Set[str] = set()
         for sub in ast.walk(node):
-            if (isinstance(sub, ast.Assign)
-                    and isinstance(sub.value, ast.Call)
-                    and _is_streaming_name(
-                        dotted_name(sub.value.func))):
+            if not (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)):
+                continue
+            cname = dotted_name(sub.value.func)
+            if _is_streaming_name(cname):
                 for t in sub.targets:
                     if isinstance(t, ast.Name):
                         bound_streams.add(t.id)
+            if _last(cname) in GATHER_LASTS:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        bound_gathers.add(t.id)
+        # names bound in THIS body (nested defs excluded): a store
+        # into a container rooted at one of these dies with the call,
+        # so it is not retention
+        local_stores: Set[str] = set()
+
+        def collect_stores(n: ast.AST) -> None:
+            if isinstance(n, (ast.FunctionDef,
+                              ast.AsyncFunctionDef)) and n is not node:
+                return
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                local_stores.add(n.id)
+            for child in ast.iter_child_nodes(n):
+                collect_stores(child)
+
+        collect_stores(node)
+
+        def escapes(root: Optional[str]) -> bool:
+            """The container outlives the call: self, a parameter, or
+            a name this body never binds (a global/closure)."""
+            return (root is not None
+                    and (root == "self" or root in fn.params
+                         or root not in local_stores))
 
         def visit(n: ast.AST) -> None:
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
@@ -449,8 +511,20 @@ class _Extractor:
                 ident = n.attr if isinstance(n, ast.Attribute) else n.id
                 if ident in ("adopt", "stage_token"):
                     fn.adopts = True
+            if isinstance(n, ast.Assign):
+                # `self.x = p` / `GLOBAL[k] = p`: the parameter's
+                # value outlives the call (GL1007's direct retention
+                # half); a store into a body-local container does not
+                v = n.value
+                if (isinstance(v, ast.Name) and v.id in fn.params
+                        and any(isinstance(t, (ast.Attribute,
+                                               ast.Subscript))
+                                and escapes(_root_name(t))
+                                for t in n.targets)):
+                    fn.retained_params.append([v.id, n.lineno])
             if isinstance(n, ast.Call):
-                self._extract_call(n, fn, bound_streams)
+                self._extract_call(n, fn, bound_streams,
+                                   bound_gathers, escapes)
             for child in ast.iter_child_nodes(n):
                 visit(child)
 
@@ -465,7 +539,8 @@ class _Extractor:
         fn.direct.setdefault(effect, [line, detail])
 
     def _extract_call(self, call: ast.Call, fn: FuncIR,
-                      bound_streams: Set[str]) -> None:
+                      bound_streams: Set[str],
+                      bound_gathers: Set[str], escapes) -> None:
         name = dotted_name(call.func)
         last = _last(name)
         line = call.lineno
@@ -509,6 +584,14 @@ class _Extractor:
                         arg.id not in fn.materialized_params:
                     fn.materialized_params.append(arg.id)
 
+        # ---- retention (GL1007's direct half) ----
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in RETAINER_METHODS and call.args
+                and escapes(_root_name(call.func.value))):
+            for arg in call.args:
+                if isinstance(arg, ast.Name) and arg.id in fn.params:
+                    fn.retained_params.append([arg.id, line])
+
         # ---- call edges ----
         if name:
             fn.calls.append(CallEdge(name=name, line=line))
@@ -535,6 +618,18 @@ class _Extractor:
                 elif isinstance(arg, ast.Name) \
                         and arg.id in bound_streams:
                     fn.stream_args.append([name, idx, line, arg.id])
+            # a gathered band submatrix passed into a call: GL1007
+            # pass site
+            if name and call.func is not arg:
+                if (isinstance(arg, ast.Call)
+                        and _last(dotted_name(arg.func))
+                        in GATHER_LASTS):
+                    fn.gather_args.append(
+                        [name, idx, line,
+                         _last(dotted_name(arg.func))])
+                elif isinstance(arg, ast.Name) \
+                        and arg.id in bound_gathers:
+                    fn.gather_args.append([name, idx, line, arg.id])
         for arg in arg_exprs:
             target = arg
             kind = "ref"
@@ -831,6 +926,7 @@ class ProgramIR:
         self._effects: Dict[FuncKey, Dict[str, Witness]] = {}
         self._adopts: Dict[FuncKey, bool] = {}
         self._mat_params: Dict[FuncKey, Dict[str, Witness]] = {}
+        self._ret_params: Dict[FuncKey, Dict[str, Witness]] = {}
         self._link()
         self._fixpoint()
 
@@ -941,6 +1037,12 @@ class ProgramIR:
             self._mat_params[key] = {
                 p: Witness(line=fn.line, detail="materialized here")
                 for p in fn.materialized_params}
+            # direct retention witnesses carry the storing line; the
+            # transitive links below carry the callee's param name in
+            # `detail` so render_retention_chain can keep walking
+            self._ret_params[key] = {
+                p: Witness(line=line, detail="")
+                for p, line in fn.retained_params}
         keys = sorted(self.functions)
         changed = True
         while changed:
@@ -968,8 +1070,6 @@ class ProgramIR:
                 # materializes
                 fn = self.functions[key]
                 for p, cname, idx, line in fn.forwarded_params:
-                    if p in self._mat_params[key]:
-                        continue
                     callee = self.resolve(self.modules[key[0]],
                                           key[1], cname)
                     if callee is None:
@@ -977,9 +1077,20 @@ class ProgramIR:
                     cfn = self.functions[callee]
                     if idx >= len(cfn.params):
                         continue
-                    if cfn.params[idx] in self._mat_params[callee]:
+                    if (p not in self._mat_params[key]
+                            and cfn.params[idx]
+                            in self._mat_params[callee]):
                         self._mat_params[key][p] = Witness(
                             line=line, detail="", callee=callee)
+                        changed = True
+                    # transitive retention, same walk: p forwarded as
+                    # arg k of a callee whose k-th param is retained
+                    if (p not in self._ret_params[key]
+                            and cfn.params[idx]
+                            in self._ret_params[callee]):
+                        self._ret_params[key][p] = Witness(
+                            line=line, detail=cfn.params[idx],
+                            callee=callee)
                         changed = True
 
     # -- queries -------------------------------------------------------
@@ -999,6 +1110,35 @@ class ProgramIR:
             return None
         p = fn.params[index]
         return p if p in self._mat_params.get(key, {}) else None
+
+    def retaining_param(self, key: FuncKey,
+                        index: int) -> Optional[str]:
+        """The name of callee param `index` when its value is stored
+        beyond the call (directly or transitively), else None."""
+        fn = self.functions.get(key)
+        if fn is None or index >= len(fn.params):
+            return None
+        p = fn.params[index]
+        return p if p in self._ret_params.get(key, {}) else None
+
+    def render_retention_chain(self, key: FuncKey, param: str) -> str:
+        """'g -> h: parameter 'q' retained at path.py:30' for GL1007
+        messages — the provenance walk from the function handed the
+        gathered value down to the storing statement."""
+        parts: List[str] = []
+        seen: Set[Tuple[FuncKey, str]] = set()
+        cur, p = key, param
+        while (cur, p) not in seen:
+            seen.add((cur, p))
+            parts.append(cur[1])
+            wit = self._ret_params.get(cur, {}).get(p)
+            if wit is None:
+                break
+            if wit.callee is None:
+                return (f"{' -> '.join(parts)}: parameter {p!r} "
+                        f"retained at {cur[0]}:{wit.line}")
+            cur, p = wit.callee, wit.detail
+        return f"{' -> '.join(parts)}: parameter {p!r} retained"
 
     def witness_chain(self, key: FuncKey,
                       effect: str) -> List[Tuple[FuncKey, Witness]]:

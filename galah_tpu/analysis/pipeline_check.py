@@ -55,6 +55,19 @@ Checks
           fails tracing or, worse, silently splits the megakernel back
           into per-window dispatches and the dispatch-count win
           evaporates. Convert at the wrapper boundary instead.
+  GL1007  a paged band-walk function holds a gathered sketch
+          submatrix across band boundaries: in a function registered
+          in ``PAGED_MODULES``, a value produced by ``gather()`` /
+          ``band_gather()`` inside the band loop is either appended
+          to a collection (accumulating every band) or referenced
+          after the loop ends. The out-of-core tier's peak-RSS win
+          (docs/memory.md) rests on at most two bands being resident
+          at a time; a retained reference pins the backing pages past
+          eviction and the paging schedule silently degrades to
+          all-resident. The submatrix handed to a helper that retains
+          it is the interprocedural GL1007 arm in effects_check
+          (GalahIR retention chain in the message); the in-function
+          cases stay lexical here — the two arms partition.
 
 Suppression: the usual inline comment with a justification —
 
@@ -98,6 +111,27 @@ _ANNOTATION_KEYS = frozenset({"streaming", "occupancy_gauge",
 
 _EXEMPT_PREFIXES = ("galah_tpu/utils/", "galah_tpu/obs/",
                     "galah_tpu/analysis/")
+
+#: GL1007 scope: module -> the band-walk functions that consume the
+#: paged sketch store (io/pagestore.py) and must release each band's
+#: gathered submatrix before the next band pages in. A module joins
+#: this registry when it grows a code path that drives `gather()` /
+#: `band_gather()` over a paged view (docs/memory.md has the pinning
+#: invariant the rule enforces).
+PAGED_MODULES: Dict[str, List[str]] = {
+    "galah_tpu/ops/bucketing.py": ["bucketed_threshold_pairs"],
+    "galah_tpu/backends/minhash_backend.py": [
+        "distances", "_paged_sketch_rows"],
+    "galah_tpu/index/store.py": ["_load_generation"],
+}
+
+#: The calls whose results GL1007 tracks (kept identical to
+#: ir.GATHER_LASTS so the interprocedural arm extends this one).
+GATHER_NAMES = frozenset({"gather", "band_gather"})
+
+#: Receiver methods that accumulate a gathered band in place.
+RETAINER_METHODS = frozenset({"append", "add", "extend",
+                              "appendleft", "setdefault"})
 
 
 def in_scope(path: str) -> bool:
@@ -216,6 +250,83 @@ def _check_device_round_sync(src: SourceFile, device_round: List[str],
     return out
 
 
+def _check_paged_retention(src: SourceFile) -> List[Finding]:
+    """GL1007 (lexical arm) over one registered module: gathered band
+    submatrices accumulated inside, or referenced after, a band loop
+    in a ``PAGED_MODULES`` band-walk function."""
+    names = PAGED_MODULES.get(src.path.replace("\\", "/"))
+    if not names:
+        return []
+    defs = _function_defs(src.tree)
+    hits: Dict[tuple, Finding] = {}
+    for fname in names:
+        fn = defs.get(fname)
+        if fn is None:
+            continue
+        for loop in [n for n in ast.walk(fn)
+                     if isinstance(n, (ast.For, ast.While))]:
+            # names bound to a gather inside this loop
+            bound: Dict[str, int] = {}
+            for n in ast.walk(loop):
+                if (isinstance(n, ast.Assign)
+                        and isinstance(n.value, ast.Call)
+                        and dotted_name(n.value.func).rsplit(".", 1)[-1]
+                        in GATHER_NAMES):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            bound[t.id] = n.lineno
+            # arm 1: the gathered band lands in an accumulator that
+            # outlives the iteration
+            for n in ast.walk(loop):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in RETAINER_METHODS
+                        and n.args):
+                    continue
+                for a in n.args:
+                    kept: Optional[str] = None
+                    if (isinstance(a, ast.Call)
+                            and dotted_name(a.func).rsplit(".", 1)[-1]
+                            in GATHER_NAMES):
+                        kept = dotted_name(a.func).rsplit(".", 1)[-1]
+                    elif isinstance(a, ast.Name) and a.id in bound:
+                        kept = a.id
+                    if kept is None:
+                        continue
+                    hits[(n.lineno, kept)] = Finding(
+                        code="GL1007", severity=Severity.WARNING,
+                        path=src.path, line=n.lineno,
+                        message=(f".{n.func.attr}() accumulates the "
+                                 f"gathered band submatrix {kept} "
+                                 f"inside {fname}()'s band loop: "
+                                 "every band stays referenced, the "
+                                 "backing pages can never evict and "
+                                 "the paging schedule degrades to "
+                                 "all-resident; reduce the band to "
+                                 "its result before accumulating"),
+                        symbol=fname)
+            # arm 2: a gather-bound name survives past the loop
+            end = getattr(loop, "end_lineno", loop.lineno)
+            for n in ast.walk(fn):
+                if (isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)
+                        and n.id in bound
+                        and n.lineno > end):
+                    hits[(n.lineno, n.id)] = Finding(
+                        code="GL1007", severity=Severity.WARNING,
+                        path=src.path, line=n.lineno,
+                        message=(f"gathered band submatrix {n.id} is "
+                                 f"referenced after {fname}()'s band "
+                                 "loop ends: the reference pins its "
+                                 "pages across band boundaries "
+                                 "(docs/memory.md allows at most two "
+                                 "resident bands); copy the needed "
+                                 "rows out or re-gather inside the "
+                                 "loop"),
+                        symbol=fname)
+    return [hits[k] for k in sorted(hits)]
+
+
 def _is_threaded(src: SourceFile) -> bool:
     """GL1003 scope: the module declares concurrency annotations."""
     return (harvest_literal(src.tree, "GUARDED_BY") is not None
@@ -310,6 +421,7 @@ def check_pipeline_file(src: SourceFile) -> List[Finding]:
         out.extend(_check_materialization(src))
     if _is_threaded(src):
         out.extend(_check_unbounded(src))
+    out.extend(_check_paged_retention(src))
 
     stage = harvest_literal(src.tree, "PIPELINE_STAGE")
     has_decl = any(

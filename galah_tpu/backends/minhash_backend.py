@@ -35,6 +35,13 @@ class SketchStore:
 
     With a `cache` (io/diskcache.py), sketches also persist across runs,
     keyed by file identity + (sketch_size, k, seed).
+
+    With a `pagestore` attached (io/pagestore.py, docs/memory.md),
+    retained sketches live as rows of the mmap-backed page store
+    instead of the `_sketches` dict: `get_cached` hands back
+    zero-copy views and peak RSS is bounded by the pagestore's LRU
+    budget instead of growing with the corpus. Both retention modes
+    serve bit-identical sketches.
     """
 
     def __init__(self, sketch_size: int, k: int, seed: int = 0,
@@ -46,23 +53,46 @@ class SketchStore:
         self.algo = algo
         self.cache = cache or diskcache.get_cache()
         self._sketches: Dict[str, MinHashSketch] = {}
+        self.pagestore = None  # io/pagestore.SketchPageStore when paged
 
     def _params(self) -> dict:
         return {"sketch_size": self.sketch_size, "k": self.k,
                 "seed": self.seed, "algo": self.algo}
 
+    def attach_pagestore(self, pagestore) -> None:
+        """Route sketch retention through a paged store: the dict's
+        current residents spill in, later inserts append directly."""
+        for path, s in self._sketches.items():
+            pagestore.append(path, s.hashes)
+        pagestore.flush()
+        self._sketches.clear()
+        self.pagestore = pagestore
+
+    def _retain(self, path: str, s: MinHashSketch) -> MinHashSketch:
+        if self.pagestore is not None:
+            self.pagestore.append(path, s.hashes)
+            return s
+        self._sketches[path] = s
+        return s
+
     def get_cached(self, path: str) -> Optional[MinHashSketch]:
-        """Sketch from memory or the disk cache only (no FASTA read)."""
+        """Sketch from memory, the page store, or the disk cache only
+        (no FASTA read)."""
         s = self._sketches.get(path)
         if s is not None:
             return s
+        if self.pagestore is not None:
+            hashes = self.pagestore.get(path)
+            if hashes is not None:
+                return MinHashSketch(hashes=hashes,
+                                     sketch_size=self.sketch_size,
+                                     kmer=self.k)
         entry = self.cache.load(path, "minhash", self._params())
         if entry is None:
             return None
         s = MinHashSketch(hashes=entry["hashes"],
                           sketch_size=self.sketch_size, kmer=self.k)
-        self._sketches[path] = s
-        return s
+        return self._retain(path, s)
 
     def sketch_only(self, genome) -> MinHashSketch:
         """Pure compute: sketch an ingested genome, no state mutation —
@@ -83,8 +113,17 @@ class SketchStore:
                  "cache)", unit="genomes").inc()
         self.cache.store(path, "minhash", self._params(),
                          {"hashes": s.hashes})
-        self._sketches[path] = s
-        return s
+        return self._retain(path, s)
+
+    def insert_prefiltered(self, path: str,
+                           s: MinHashSketch) -> MinHashSketch:
+        """Record a sketch the ingest prefilter resolved without the
+        full pipeline (ops/prefilter.py): cached and retained like
+        `insert`, but NOT counted as computed — bench throughput and
+        the report funnel stay honest about work actually done."""
+        self.cache.store(path, "minhash", self._params(),
+                         {"hashes": s.hashes})
+        return self._retain(path, s)
 
     def put_from_genome(self, path: str, genome) -> MinHashSketch:
         """Sketch an already-ingested genome and cache it."""
@@ -241,6 +280,102 @@ class MinHashPreclusterer(PreclusterBackend):
             out.update(inc)
         return out
 
+    def _make_pagestore(self):
+        """The run's paged sketch store (docs/memory.md): a fresh
+        directory under the disk cache (or TMPDIR when caching is
+        off), SENTINEL-filled so gathered rows are bit-identical to
+        ops/minhash.sketch_matrix rows."""
+        import atexit
+        import shutil
+        import tempfile
+
+        from galah_tpu.io.pagestore import SketchPageStore
+        from galah_tpu.ops.constants import SENTINEL
+
+        base = self.store.cache.path if self.store.cache.enabled else None
+        d = tempfile.mkdtemp(prefix="pagestore-", dir=base)
+        atexit.register(shutil.rmtree, d, ignore_errors=True)
+        return SketchPageStore(d, cols=self.sketch_size, fill=SENTINEL)
+
+    def _paged_sketch_rows(self, genome_paths: Sequence[str]):
+        """Stream-sketch into the mmap-backed page store and return
+        the duck-typed row view the bucketed band walk gathers from —
+        the full (N, K) sketch matrix is never materialized and peak
+        RSS is bounded by the pagestore budget plus two bands' pages
+        (docs/memory.md)."""
+        import numpy as np
+
+        from galah_tpu.io.pagestore import PagedRowView
+        from galah_tpu.ops.sketch_stream import iter_path_sketches
+
+        ps = self._make_pagestore()
+        self.store.attach_pagestore(ps)
+        logger.info(
+            "Paged sketch retention engaged: %d genomes, %d MiB "
+            "resident budget", len(genome_paths),
+            ps.budget_bytes >> 20)
+        for _p, _s in iter_path_sketches(genome_paths, self.store,
+                                         threads=self.threads):
+            pass
+        ps.flush()
+        rids = np.empty(len(genome_paths), dtype=np.int64)
+        for i, p in enumerate(genome_paths):
+            rid = ps.rid_for(p)
+            if rid is None:
+                raise RuntimeError(
+                    f"paged sketch retention lost {p!r}")
+            rids[i] = rid
+        return PagedRowView(ps, rids)
+
+    def _hll_cardinalities_chunked(self, genome_paths: Sequence[str],
+                                   chunk: int = 512):
+        """`_hll_cardinalities` with bounded residency for the paged
+        path: register rows are loaded (mostly from the prefilter's
+        pre-warmed cache entries), reduced to their f64 cardinality
+        chunk by chunk, and dropped — cardinality is a per-row
+        reduction, so the values are bit-identical to the stacked
+        pass. Peak extra memory is one chunk of registers (~2 MB at
+        p=12, chunk=512) instead of N rows."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from galah_tpu.backends.hll_backend import HLLPreclusterer
+        from galah_tpu.io.fasta import read_genome
+        from galah_tpu.obs import metrics as obs_metrics
+        from galah_tpu.ops import hll as hll_ops
+
+        h = HLLPreclusterer(
+            min_ani=self.min_ani, k=self.k, seed=self.store.seed,
+            hash_algo=self.store.algo, cache=self.store.cache,
+            threads=self.threads)
+        params = {"p": h.p, "k": h.k, "seed": h.seed, "algo": h.algo}
+        unique = list(dict.fromkeys(genome_paths))
+        card_by_path: dict = {}
+        for lo in range(0, len(unique), chunk):
+            paths = unique[lo:lo + chunk]
+            rows = []
+            for path in paths:
+                entry = h.cache.load(path, "hll", params)
+                if entry is not None:
+                    rows.append(entry["regs"])
+                    continue
+                row = hll_ops.hll_sketch_genome(
+                    read_genome(path), p=h.p, k=h.k, seed=h.seed,
+                    algo=h.algo)
+                obs_metrics.counter(
+                    "sketch.hll_computed",
+                    help="HLL register rows computed (not served from "
+                         "any cache)", unit="genomes").inc()
+                h.cache.store(path, "hll", params, {"regs": row})
+                rows.append(row)
+            cards = np.asarray(
+                hll_ops.hll_cardinality(jnp.asarray(np.stack(rows))),
+                dtype=np.float64)
+            for path, c in zip(paths, cards):
+                card_by_path[path] = c
+        return (np.array([card_by_path[p] for p in genome_paths],
+                         dtype=np.float64), h.p)
+
     def _hll_cardinalities(self, genome_paths: Sequence[str]):
         """(n,) f64 HLL cardinality estimates for the bucketed pair
         pass, through the same disk-cache kind ('hll') the dashing
@@ -276,24 +411,36 @@ class MinHashPreclusterer(PreclusterBackend):
         logger.info(
             "Sketching MinHash representations of %d genomes on device ..",
             len(genome_paths))
-        with timing.stage("sketch-minhash"):
-            from galah_tpu.parallel import distributed
-
-            if distributed.process_count() > 1:
-                mat = self._sketch_matrix_multihost(genome_paths)
-            else:
-                by_path = self._sketch_paths(genome_paths)
-                sketches = [by_path[p] for p in genome_paths]
-                mat = sketch_matrix(sketches,
-                                    sketch_size=self.sketch_size)
+        from galah_tpu.io.pagestore import pagestore_engaged
         from galah_tpu.ops.bucketing import (
             bucketed_threshold_pairs,
             bucketing_engaged,
         )
         from galah_tpu.parallel import distributed as _dist
 
-        if (bucketing_engaged(len(genome_paths))
-                and _dist.process_count() == 1):
+        bucketed = (bucketing_engaged(len(genome_paths))
+                    and _dist.process_count() == 1)
+        # Out-of-core tier (docs/memory.md): the band walk of the
+        # bucketed pass is also a paging schedule, so with both
+        # engaged the sketch rows can live in the mmap-backed page
+        # store and only bands b u (b+1) are ever resident.
+        paged = (bucketed
+                 and pagestore_engaged(len(genome_paths),
+                                       self.sketch_size))
+        with timing.stage("sketch-minhash"):
+            from galah_tpu.parallel import distributed
+
+            if distributed.process_count() > 1:
+                mat = self._sketch_matrix_multihost(genome_paths)
+            elif paged:
+                mat = self._paged_sketch_rows(genome_paths)
+            else:
+                by_path = self._sketch_paths(genome_paths)
+                sketches = [by_path[p] for p in genome_paths]
+                mat = sketch_matrix(sketches,
+                                    sketch_size=self.sketch_size)
+
+        if bucketed:
             # Hierarchical precluster: HLL cardinality bands prune the
             # pair lattice before any MinHash screening; the kept pair
             # dict is bit-identical to the unbucketed pass
@@ -301,7 +448,11 @@ class MinHashPreclusterer(PreclusterBackend):
             logger.info("Computing cardinality-bucketed all-pairs "
                         "Mash ANI ..")
             with timing.stage("precluster-hll-cards"):
-                cards, hll_p = self._hll_cardinalities(genome_paths)
+                if paged:
+                    cards, hll_p = self._hll_cardinalities_chunked(
+                        genome_paths)
+                else:
+                    cards, hll_p = self._hll_cardinalities(genome_paths)
             with timing.stage("pairwise-minhash"):
                 pairs = bucketed_threshold_pairs(
                     mat, cards, k=self.k, min_ani=self.min_ani,

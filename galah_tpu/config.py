@@ -227,6 +227,35 @@ _FLAG_DEFS: Tuple[Flag, ...] = (
               "bands, and schedule only same- and adjacent-band "
               "pairs. auto engages above the sparse-screen crossover; "
               "1 forces it at any N; 0 disables it"),
+    Flag("GALAH_TPU_PAGESTORE", section="kernel", default="auto",
+         choices=("auto", "0", "1"),
+         help="Out-of-core tiered sketch memory (docs/memory.md): "
+              "sketch rows live in an mmap-backed page store under "
+              "the cache dir and only the active band window is "
+              "resident, bounding peak RSS while clusterings stay "
+              "bit-identical to the all-resident path. auto engages "
+              "when the bucketed precluster is engaged and the "
+              "projected sketch matrix exceeds the RAM budget; 1 "
+              "forces paging whenever bucketing is engaged; 0 "
+              "disables it"),
+    Flag("GALAH_TPU_SKETCH_RAM_MB", kind="int", default="512",
+         section="kernel",
+         help="Hard byte budget, in MiB, for the resident (mmapped "
+              "and LRU-pinned) page set of the out-of-core sketch "
+              "store (docs/memory.md). Band-pinned pages are never "
+              "evicted, so the effective floor is two bands' pages; "
+              "malformed values are logged and ignored"),
+    Flag("GALAH_TPU_PREFILTER", section="kernel", default="auto",
+         choices=("auto", "0", "1"),
+         help="Ingest-time probabilistic k-mer prefilter "
+              "(docs/memory.md): computes HLL registers during the "
+              "streamed ingest (C fast path) and screens exact-"
+              "duplicate and degenerate (no valid k-mer window) "
+              "genomes before full sketching under a provably "
+              "conservative skip rule — pair sets and clusterings "
+              "are bit-identical with the prefilter off. auto "
+              "engages with the streamed single-process ingest; 1 "
+              "forces it; 0 disables it"),
     Flag("GALAH_TPU_PALLAS_HASH", kind="bool", section="kernel",
          help="1 forces the quarantined Mosaic murmur3 kernel, 0 "
               "forces the XLA u64 emulation; unset uses the "

@@ -127,7 +127,9 @@ class IndexState:
     generation: int
     genomes: List[str]                      # paths, greedy order
     keys: List[str]                         # content-hash per genome
-    sketches: List[np.ndarray]              # uint64 bottom-k hashes
+    # uint64 bottom-k hashes; a PagedSketchList above the out-of-core
+    # threshold (list-compatible, rows served from the mmap pagestore)
+    sketches: "List[np.ndarray]"
     pairs: Dict[Tuple[int, int], float]     # i<j, precluster-hit ANIs
     reps: List[int]                         # sorted ascending, live
     membership: Dict[int, int]              # live non-rep -> its rep
@@ -146,6 +148,54 @@ class IndexState:
 def _empty_state() -> IndexState:
     return IndexState(generation=0, genomes=[], keys=[], sketches=[],
                       pairs={}, reps=[], membership={}, tombstones=set())
+
+
+class PagedSketchList:
+    """List-compatible facade over an mmap-backed page store
+    (io/pagestore.py): ``[i]`` / ``append`` / ``len`` / iteration —
+    exactly the surface IndexState.sketches consumers use — while
+    only the LRU-budgeted resident page set occupies RAM, so `index
+    build/insert` inherit the out-of-core bound (docs/memory.md).
+    Reads hand back zero-copy views of the true (unpadded) hash
+    arrays, bit-identical to the materialized list."""
+
+    def __init__(self, pagestore) -> None:
+        self._ps = pagestore
+
+    def __len__(self) -> int:
+        return len(self._ps)
+
+    def __getitem__(self, i):
+        n = len(self._ps)
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(n))]
+        if i < 0:
+            i += n
+        return self._ps.hashes(i)
+
+    def append(self, hashes) -> None:
+        self._ps.append("", np.asarray(hashes, dtype=np.uint64))
+
+    def __iter__(self):
+        for i in range(len(self._ps)):
+            yield self._ps.hashes(i)
+
+
+def _paged_sketch_spill(n_genomes: int, sketch_size: int):
+    """A fresh pagestore-backed sketch list when the out-of-core tier
+    engages for this index size, else None (plain list loading)."""
+    import atexit
+    import shutil
+    import tempfile
+
+    from galah_tpu.io import pagestore as pagestore_mod
+
+    if not pagestore_mod.pagestore_engaged(n_genomes, sketch_size):
+        return None
+    d = tempfile.mkdtemp(prefix="galah-index-pages-")
+    atexit.register(shutil.rmtree, d, ignore_errors=True)
+    return PagedSketchList(
+        pagestore_mod.SketchPageStore(d, cols=sketch_size))
 
 
 def _valid_frames(path: str) -> List[bytes]:
@@ -300,7 +350,14 @@ class IndexStore:
         srecs = self._committed(_SKETCHES, n_genomes)
         precs = self._committed(_PAIRS, n_pairs)
 
-        genomes, keys, sketches = [], [], []
+        genomes, keys = [], []
+        # Out-of-core tier: above the paging threshold the parsed
+        # sketch rows spill straight to an mmap-backed page store
+        # instead of accumulating as N resident arrays; the facade is
+        # list-compatible so every consumer is unchanged.
+        spill = _paged_sketch_spill(
+            n_genomes, int(self.params["sketch_size"]))
+        sketches = spill if spill is not None else []
         for n, (g, s) in enumerate(zip(grecs, srecs)):
             if int(g["i"]) != n or int(s["i"]) != n:
                 raise IndexCorrupt(

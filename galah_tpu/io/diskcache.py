@@ -23,6 +23,18 @@ Design:
   * the cache is strictly optional: ``CacheDir(None)`` is a no-op store,
     so call sites keep one code path.
 
+Shared-directory hygiene: opening a ``CacheDir`` sweeps ``.tmp``
+debris from killed writers, but only files older than
+``io/atomic.py``'s ``SHARED_TMP_MAX_AGE_S`` (3600 s) — the cache
+directory is shared between concurrent runs, and a *fresh* ``.tmp``
+may belong to a live writer mid-commit; the age gate makes the sweep
+safe without any cross-process locking.
+
+Byte-level telemetry: ``cache.bytes_read`` / ``cache.bytes_written``
+counters track entry traffic alongside the hit/miss counters, so the
+run report can attribute cache IO against the pagestore's
+(docs/memory.md) page traffic.
+
 Enabled via ``--sketch-cache DIR`` on the CLI or the
 ``GALAH_TPU_CACHE`` environment variable.
 """
@@ -122,6 +134,13 @@ class CacheDir:
         self.hits += 1
         self._count("cache.hits",
                     "Sketch/profile cache entries reused from disk")
+        try:
+            nbytes = os.stat(entry).st_size
+        except OSError:
+            nbytes = 0
+        self._count("cache.bytes_read",
+                    "Bytes of cache entries read back from disk",
+                    unit="bytes", delta=nbytes)
         return out
 
     def _repair(self, entry: str,
@@ -142,13 +161,14 @@ class CacheDir:
         return None
 
     @staticmethod
-    def _count(name: str, help: str) -> None:
+    def _count(name: str, help: str, unit: str = "",
+               delta: int = 1) -> None:
         # Mirrored into the run report's precluster funnel (cache hit
         # rate); loads can come from prefetch worker threads, which the
         # registry lock makes safe.
         from galah_tpu.obs import metrics as obs_metrics
 
-        obs_metrics.counter(name, help=help).inc()
+        obs_metrics.counter(name, help=help, unit=unit).inc(delta)
 
     def store(self, genome_path: str, kind: str, params: dict,
               arrays: Dict[str, np.ndarray]) -> None:
@@ -163,6 +183,13 @@ class CacheDir:
                                        dtype=np.uint64)
         atomic.write_npz(entry, payload,
                          site=f"io.atomic.write[cache.{kind}]")
+        try:
+            nbytes = os.stat(entry).st_size
+        except OSError:
+            nbytes = 0
+        self._count("cache.bytes_written",
+                    "Bytes of cache entries committed to disk",
+                    unit="bytes", delta=nbytes)
 
     def stats(self) -> str:
         return f"{self.hits} hits / {self.misses} misses"

@@ -75,6 +75,7 @@ def _rss_mb() -> Optional[float]:
 GUARDED_BY = {
     "Heartbeat._beats": "Heartbeat._lock",
     "Heartbeat._occ": "Heartbeat._lock",
+    "Heartbeat._rss": "Heartbeat._lock",
     "Heartbeat._final_done": "Heartbeat._lock",
 }
 LOCK_ORDER = ["Heartbeat._lock"]
@@ -99,6 +100,11 @@ class Heartbeat:
         self._beats = 0
         # stage -> [min, sum, count, last] occupancy accumulator
         self._occ: Dict[str, list] = {}
+        # [min, sum, count, peak] rss_mb accumulator — peak RSS is the
+        # out-of-core tier's acceptance metric (docs/memory.md), so
+        # the run report summarizes the whole beat series, not just
+        # the final sample
+        self._rss: Optional[list] = None
         self._final_done = False
         # sampler thread: only READS the registries (each behind its
         # own lock); it never emits stage telemetry, so there is no
@@ -177,6 +183,15 @@ class Heartbeat:
                     acc[1] += v
                     acc[2] += 1
                     acc[3] = v
+            rss = rec.get("rss_mb")
+            if isinstance(rss, (int, float)):
+                if self._rss is None:
+                    self._rss = [rss, rss, 1, rss]
+                else:
+                    self._rss[0] = min(self._rss[0], rss)
+                    self._rss[1] += rss
+                    self._rss[2] += 1
+                    self._rss[3] = max(self._rss[3], rss)
         atomic.append_jsonl(self.path, rec,
                             site="io.atomic.append[heartbeat]")
         # OpenMetrics textfile tick rides the beat cadence: one
@@ -217,8 +232,17 @@ class Heartbeat:
                 for stage, acc in sorted(self._occ.items())
             }
             beats = self._beats
-        return {"period_s": self.period_s, "beats": beats,
-                "path": self.path, "occupancy_series": series}
+            rss = None
+            if self._rss is not None:
+                rss = {"min_mb": round(self._rss[0], 1),
+                       "mean_mb": round(self._rss[1] / self._rss[2], 1),
+                       "peak_mb": round(self._rss[3], 1),
+                       "samples": self._rss[2]}
+        out = {"period_s": self.period_s, "beats": beats,
+               "path": self.path, "occupancy_series": series}
+        if rss is not None:
+            out["rss_series"] = rss
+        return out
 
 
 # The active heartbeat, None when GALAH_OBS_HEARTBEAT_S is unset/0.

@@ -27,7 +27,7 @@ logger = logging.getLogger(__name__)
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
                            "run_report.schema.json")
 # v8: lint.timings_s — per-checker-family wall seconds (additive)
-REPORT_VERSION = 9  # v9: fleet_rollup (cross-shard critical path)
+REPORT_VERSION = 10  # v10: memory (rss min/mean/peak + pagestore)
 
 # disp[<stage>] / sync[<stage>] — the StageTimer's dispatch counters
 _DISP_RE = re.compile(r"^(disp|sync)\[(.*)\]$")
@@ -230,9 +230,41 @@ def assemble(subcommand: str,
             report["flow"] = flow_snap
     except Exception:  # additive section (v6); never lose a report
         logger.debug("flow snapshot failed", exc_info=True)
+    try:
+        mem = _memory_section(report)
+        if mem:
+            report["memory"] = mem
+    except Exception:  # additive section (v10); never lose a report
+        logger.debug("memory section failed", exc_info=True)
     if lint is not None:
         report["lint"] = lint
     return report
+
+
+def _memory_section(report: dict) -> dict:
+    """Host-memory summary (v10): the heartbeat's per-beat `rss_mb`
+    series folded to min/mean/peak — peak RSS is the out-of-core
+    tier's acceptance metric (docs/memory.md) — plus the pagestore's
+    traffic counters when the paged sketch path ran."""
+    mem: dict = {}
+    rss = (((report.get("flow") or {}).get("heartbeat") or {})
+           .get("rss_series"))
+    if rss:
+        mem["rss_mb"] = rss
+    mets = report.get("metrics") or {}
+    resident = (mets.get("pagestore.resident_bytes") or {}).get("value")
+    if resident is not None:
+        mem["pagestore"] = {
+            "resident_bytes": resident,
+            "page_ins": (mets.get("pagestore.page_ins") or {})
+            .get("value", 0),
+            "page_outs": (mets.get("pagestore.page_outs") or {})
+            .get("value", 0),
+        }
+    skipped = (mets.get("prefilter.skipped") or {}).get("value")
+    if skipped is not None:
+        mem["prefilter_skipped"] = skipped
+    return mem
 
 
 def write(path: str, report: dict) -> None:
@@ -397,6 +429,27 @@ def render(report: dict) -> str:
                 f"  {stage:<10} {s.get('min', 0.0):.2f}/"
                 f"{s.get('mean', 0.0):.2f}/{s.get('last', 0.0):.2f} "
                 f"{bar}")
+    mem = report.get("memory") or {}
+    if mem:
+        lines += ["", "memory:"]
+        rss = mem.get("rss_mb") or {}
+        if rss:
+            lines.append(
+                f"  rss: {rss.get('min_mb', 0.0):.0f}/"
+                f"{rss.get('mean_mb', 0.0):.0f}/"
+                f"{rss.get('peak_mb', 0.0):.0f} MB min/mean/peak "
+                f"({rss.get('samples', 0)} beat(s))")
+        pstore = mem.get("pagestore") or {}
+        if pstore:
+            lines.append(
+                f"  pagestore: {int(pstore.get('resident_bytes', 0))} "
+                f"bytes resident, {int(pstore.get('page_ins', 0))} "
+                f"page-ins / {int(pstore.get('page_outs', 0))} "
+                "page-outs")
+        if mem.get("prefilter_skipped") is not None:
+            lines.append(
+                f"  prefilter skips: {int(mem['prefilter_skipped'])} "
+                "genome(s) (bit-identical by construction)")
     lines += [
         "",
         "resilience:",
@@ -710,6 +763,25 @@ def diff(a: dict, b: dict, label_a: str = "A",
         db_ = (fb.get("flows") or {}).get("dropped", 0)
         if da_ or db_:
             lines.append(f"  dropped flows: {da_} -> {db_}")
+
+    # memory drift — additive v10 section; peak RSS is the out-of-core
+    # tier's acceptance metric, so its drift is the headline number.
+    ma, mb = a.get("memory"), b.get("memory")
+    if ma is not None or mb is not None:
+        ma, mb = ma or {}, mb or {}
+        lines += ["", "memory drift:"]
+        pa = (ma.get("rss_mb") or {}).get("peak_mb")
+        pb = (mb.get("rss_mb") or {}).get("peak_mb")
+        if pa is not None or pb is not None:
+            pa_f, pb_f = float(pa or 0.0), float(pb or 0.0)
+            lines.append(
+                f"  peak rss: {pa_f:.0f} -> {pb_f:.0f} MB "
+                f"({pb_f - pa_f:+.0f} MB)")
+        for key in ("page_ins", "page_outs"):
+            va = int((ma.get("pagestore") or {}).get(key, 0))
+            vb = int((mb.get("pagestore") or {}).get(key, 0))
+            if va or vb:
+                lines.append(f"  {key}: {va} -> {vb} ({vb - va:+d})")
 
     la, lb = a.get("lint"), b.get("lint")
     if la is not None or lb is not None:

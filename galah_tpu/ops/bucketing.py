@@ -147,7 +147,15 @@ def bucketed_threshold_pairs(
     aligned with `sketch_mat` rows; `pair_pass` (default
     ops/pairwise.threshold_pairs) maps a row-subset matrix to its
     local pair dict and is free to route to the C / sparse / 1-D / 2D
-    mesh implementations — every one is per-pair exact."""
+    mesh implementations — every one is per-pair exact.
+
+    `sketch_mat` may be a real (N, K) matrix or any duck-typed object
+    with `.shape` and a `band_gather(indices) -> contiguous submatrix`
+    method (io/pagestore.py): the band walk only ever gathers bands
+    b u (b+1), which is exactly the paging schedule — a paged store
+    pins at most two bands' pages at once and the submatrices handed
+    to `pair_pass` are bit-identical to all-resident slicing, so the
+    pair dict is too (docs/memory.md)."""
     from galah_tpu.obs import events, metrics as obs_metrics
 
     n = sketch_mat.shape[0]
@@ -168,6 +176,10 @@ def bucketed_threshold_pairs(
         int(b): np.nonzero(bands == b)[0]
         for b in np.unique(bands).tolist()}
 
+    # Paged stores expose band_gather: rows of bands b u (b+1) land in
+    # one contiguous copy while only their pages are pinned resident.
+    band_gather = getattr(sketch_mat, "band_gather", None)
+
     out: Dict[Tuple[int, int], float] = {}
     for b in sorted(members):
         own = members[b]
@@ -177,7 +189,10 @@ def bucketed_threshold_pairs(
         if idx.shape[0] < 2:
             continue
         in_b = set(own.tolist())
-        sub = pair_pass(np.ascontiguousarray(sketch_mat[idx]))
+        if band_gather is not None:
+            sub = pair_pass(band_gather(idx))
+        else:
+            sub = pair_pass(np.ascontiguousarray(sketch_mat[idx]))
         for (a, bb), ani in sub.items():
             ga, gb = int(idx[a]), int(idx[bb])
             # within-(b+1) pairs belong to S_{b+1}'s run

@@ -1,0 +1,214 @@
+"""Ingest-time probabilistic k-mer prefilter — tier 1 of the sketch
+memory hierarchy (docs/memory.md).
+
+Runs inside the streamed sketch stage (`ops/sketch_stream.py`),
+screening genomes *before* they reach the batched device sketcher:
+
+* **Duplicate screen** — a content digest (sha256 over the 2-bit code
+  array and contig offsets) spots byte-identical genomes behind
+  different paths.  The MinHash sketch is a pure function of the
+  canonical k-mer multiset, which is itself a pure function of
+  (codes, contig_offsets, k), so aliasing the first occurrence's
+  sketch is *bit-identical* to recomputing it — the provably
+  conservative case of deduplication.
+* **Degenerate screen** — a genome with no valid k-mer window (every
+  contig shorter than k, or no run of k unambiguous bases) has an
+  empty k-mer set; its sketch is computed by the per-genome host
+  sketcher (bit-identical to every batched strategy by the strategy
+  contract) without occupying a device batch slot.
+* **HLL pre-warm** — while the genome codes are hot in cache, the HLL
+  registers the bucketed precluster needs later are computed on the C
+  fast path (csrc/sketch.c::galah_hll_registers) and stored under the
+  exact diskcache key `HLLPreclusterer` probes (kind="hll",
+  params {p, k, seed, algo}), so the cardinality pass that drives the
+  band-paging schedule never re-reads the FASTA files.
+
+Conservativeness argument
+-------------------------
+A skip is only taken when the skipped genome's sketch is *provably
+equal* to what the full pipeline would produce (duplicate: same input
+bytes; degenerate: empty k-mer set).  Low k-mer cardinality alone is
+measured (it feeds the band schedule) but never skips — "looks
+low-complexity" cannot be conservative, because two low-complexity
+genomes can still share a cluster.  Hence: prefilter on/off changes
+no pair set and no clustering, bit for bit; the `prefilter.skipped`
+counter is the only observable difference.
+
+Gate: ``GALAH_TPU_PREFILTER`` (auto / 0 / 1).  auto engages with the
+streamed single-process ingest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Dict, Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def prefilter_mode() -> str:
+    """The ``GALAH_TPU_PREFILTER`` tri-state: 'auto', '0' or '1'."""
+    from galah_tpu import config
+
+    val = config.env_value("GALAH_TPU_PREFILTER") or "auto"
+    return val if val in ("auto", "0", "1") else "auto"
+
+
+def prefilter_engaged() -> bool:
+    """Whether the ingest prefilter should run for this process.
+
+    '1' forces it, '0' disables it; 'auto' engages on single-process
+    runs (the streamed ingest path — multi-host runs shard paths per
+    host, where cross-host duplicates would dodge the digest table
+    anyway)."""
+    mode = prefilter_mode()
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    from galah_tpu.parallel import distributed
+
+    return distributed.process_count() == 1
+
+
+def _digest(genome) -> str:
+    """Content digest of the parsed genome: identical digests imply
+    identical canonical k-mer multisets, hence identical sketches."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(genome.codes).tobytes())
+    h.update(b"|")
+    h.update(np.ascontiguousarray(genome.contig_offsets).tobytes())
+    return h.hexdigest()
+
+
+def _has_valid_window(genome, k: int) -> bool:
+    """True unless the genome provably has zero valid k-mer windows
+    (no contig holds k consecutive unambiguous bases)."""
+    codes = genome.codes
+    offsets = genome.contig_offsets
+    if codes.shape[0] < k:
+        return False
+    valid = codes != 255
+    for c in range(offsets.shape[0] - 1):
+        lo, hi = int(offsets[c]), int(offsets[c + 1])
+        if hi - lo < k:
+            continue
+        run = valid[lo:hi]
+        if run.all():
+            return True
+        # longest run of True: diff over padded cumulative resets
+        idx = np.flatnonzero(~run)
+        edges = np.concatenate(([-1], idx, [run.shape[0]]))
+        if int(np.diff(edges).max()) - 1 >= k:
+            return True
+    return False
+
+
+class IngestPrefilter:
+    """Screens the streamed miss iterator; resolves screened paths to
+    their provably-equal sketches at merge time.
+
+    Single-threaded contract: ``screen`` is pulled by the compute
+    pipeline's consumer chain and ``resolve`` by the merge loop — both
+    on the consumer side of the stream, never concurrently."""
+
+    def __init__(self, store, prewarm_hll: bool = True):
+        from galah_tpu.obs import metrics as obs_metrics
+
+        self.store = store
+        # Pre-warming needs somewhere durable to put the registers; a
+        # disabled cache (CacheDir(None)) would throw the work away.
+        self.prewarm_hll = (prewarm_hll
+                            and getattr(store.cache, "enabled", False))
+        self._by_digest: Dict[str, str] = {}     # digest -> first path
+        self._aliases: Dict[str, str] = {}       # dup path -> first path
+        self._degenerate: Dict[str, object] = {}  # path -> MinHashSketch
+        self._c_skipped = obs_metrics.counter(
+            "prefilter.skipped", unit="genomes",
+            help="genomes screened out of the full sketch pipeline by "
+                 "the ingest prefilter (skips are provably "
+                 "bit-identical: duplicates alias the first "
+                 "occurrence's sketch, degenerate genomes have an "
+                 "empty k-mer set)")
+        self._c_dup = obs_metrics.counter(
+            "prefilter.skipped_duplicate", unit="genomes",
+            help="prefilter skips taken because the genome bytes "
+                 "duplicate an earlier path")
+        self._c_degen = obs_metrics.counter(
+            "prefilter.skipped_degenerate", unit="genomes",
+            help="prefilter skips taken because the genome has no "
+                 "valid k-mer window")
+        self._c_prewarm = obs_metrics.counter(
+            "prefilter.hll_prewarmed", unit="genomes",
+            help="HLL register rows computed during ingest and cached "
+                 "for the bucketed precluster's cardinality pass")
+
+    # -- producer side -----------------------------------------------------
+
+    def screen(self, miss_iter: Iterable) -> Iterator:
+        """Filter (path, genome) pairs: forward genomes that need the
+        full sketch pipeline, record provable skips for ``resolve``."""
+        for path, genome in miss_iter:
+            if self.prewarm_hll:
+                self._prewarm(path, genome)
+            digest = _digest(genome)
+            first = self._by_digest.get(digest)
+            if first is not None:
+                self._aliases[path] = first
+                self._c_skipped.inc()
+                self._c_dup.inc()
+                continue
+            self._by_digest[digest] = path
+            if not _has_valid_window(genome, self.store.k):
+                # empty k-mer set: the host per-genome sketcher is
+                # bit-identical to every batched strategy and costs
+                # nothing here (no windows to hash)
+                self._degenerate[path] = self.store.sketch_only(genome)
+                self._c_skipped.inc()
+                self._c_degen.inc()
+                continue
+            yield path, genome
+
+    def _prewarm(self, path: str, genome) -> None:
+        from galah_tpu.ops import hll
+
+        params = {"p": hll.DEFAULT_P, "k": self.store.k,
+                  "seed": self.store.seed, "algo": self.store.algo}
+        try:
+            if self.store.cache.load(path, "hll", params) is not None:
+                return
+            row = hll.hll_sketch_genome(
+                genome, p=hll.DEFAULT_P, k=self.store.k,
+                seed=self.store.seed, algo=self.store.algo)
+            self.store.cache.store(path, "hll", params, {"regs": row})
+            self._c_prewarm.inc()
+        except Exception as exc:  # pre-warm is an optimization only
+            logger.warning("HLL pre-warm failed for %s: %s", path, exc)
+            self.prewarm_hll = False
+
+    # -- consumer side -----------------------------------------------------
+
+    def resolve(self, path: str):
+        """The screened path's sketch, or None if the path went
+        through the full pipeline.  Must succeed for every path
+        ``screen`` skipped — the merge loop has no other source."""
+        s = self._degenerate.pop(path, None)
+        if s is not None:
+            return s
+        first = self._aliases.get(path)
+        if first is None:
+            return None
+        s = self.store.get_cached(first)
+        if s is None:
+            raise RuntimeError(
+                f"prefilter invariant broken: duplicate {path!r} "
+                f"aliases {first!r} but its sketch is not retained")
+        return s
+
+
+def maybe_prefilter(store) -> Optional[IngestPrefilter]:
+    """An armed prefilter when the gate engages, else None."""
+    return IngestPrefilter(store) if prefilter_engaged() else None

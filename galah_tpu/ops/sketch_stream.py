@@ -516,6 +516,16 @@ def iter_path_sketches(
 
     miss_iter = counting(miss_iter)
 
+    # Ingest-time prefilter (ops/prefilter.py): provably conservative
+    # duplicate/degenerate screening ahead of the batched sketcher,
+    # plus the HLL pre-warm the bucketed pass reuses. Screened paths
+    # never reach `computed`; the merge loop resolves them instead.
+    from galah_tpu.ops import prefilter as _prefilter
+
+    pre = _prefilter.maybe_prefilter(store)
+    if pre is not None:
+        miss_iter = pre.screen(miss_iter)
+
     if strategy == "fused":
         computed = _iter_fused_sketches(
             miss_iter, store.sketch_size, store.k, store.seed,
@@ -551,18 +561,44 @@ def iter_path_sketches(
     # original order — the property the overlapped pair pass needs.
     wait_s = 0.0
     yielded = 0
+    # One-slot pushback: when the compute pipeline runs ahead of the
+    # merge walk (its look-ahead pulled paths the prefilter screened
+    # out), the next computed sketch parks here until its path comes
+    # up in the walk.
+    parked: Optional[tuple] = None
     for p in dict.fromkeys(paths):
         s = hits.get(p)
+        if s is None and pre is not None:
+            ps = pre.resolve(p)
+            if ps is not None:
+                s = store.insert_prefiltered(p, ps)
+        if s is None and parked is not None and parked[0] == p:
+            s = store.insert(p, parked[1])
+            parked = None
         if s is None:
             # time blocked on the producer = consumer starvation; the
             # complement is the occupancy the overlap is meant to buy
             # (obs/flow records it as the sketch stage's
             # upstream-empty wait for `galah-tpu flow analyze`)
             with obs_flow.blocked("sketch", "upstream-empty") as bw:
-                cp, s = next(computed)
+                try:
+                    cp, cs = next(computed)
+                except StopIteration:
+                    cp, cs = None, None
             wait_s += bw.seconds
-            assert cp == p, f"sketch stream out of order: {cp} != {p}"
-            s = store.insert(p, s)
+            if cp == p:
+                s = store.insert(p, cs)
+            else:
+                # p was screened while the pipeline looked ahead to
+                # cp (or to exhaustion): the skip record exists now.
+                assert parked is None, \
+                    f"sketch stream out of order: {cp} != {p}"
+                if cp is not None:
+                    parked = (cp, cs)
+                ps = pre.resolve(p) if pre is not None else None
+                assert ps is not None, \
+                    f"sketch stream out of order: {cp} != {p}"
+                s = store.insert_prefiltered(p, ps)
         yield p, s
         yielded += 1
         # live gauge refresh so the heartbeat samples a moving

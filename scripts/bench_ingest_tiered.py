@@ -1,0 +1,268 @@
+"""Tiered (out-of-core) sketch store vs all-resident: peak RSS and
+ingest rate at N in {1k, 20k, 100k} synthetic genomes.
+
+The tentpole claim of the memory hierarchy (docs/memory.md) is that
+the paged band walk completes the same workload with a peak RSS bound
+by the pagestore budget instead of the corpus size, bit-identically.
+Each (rung, paging on/off) variant runs in its own subprocess so
+``ru_maxrss`` is a clean per-variant high-water mark:
+
+  * ingest: N synthetic planted-family sketch rows stream into either
+    an all-resident ``(N, K)`` u64 matrix (paging off — the resident
+    cost IS the matrix) or a ``SketchPageStore`` under a 16 MiB
+    budget (paging on — rows page out as they arrive);
+  * pair pass: the bucketed band walk over the first
+    ``min(N, PARITY_ROWS)`` rows, paged vs dense — the sha256 digest
+    of the pair dict is the parity gate (identical planted rows +
+    identical cards => must match bit for bit).
+
+Self-budgeting: rungs are admitted in order while the measured wall
+extrapolates into ``--budget``; skipped rungs are recorded, never
+silently dropped. Prints one JSON line per variant and a final
+``TIERED_JSON`` summary (bench.py flattens its ``pagestore_*`` keys
+into the perf ledger's ``bench.pagestore_*`` gauges).
+
+Usage: python scripts/bench_ingest_tiered.py [--budget 480]
+       [--rungs 1000,20000,100000] [--width 1000]
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Rows entering the bucketed pair-pass parity gate per rung — the
+#: RSS story is carried by ingest; the pair pass is capped so CPU
+#: rungs stay inside the stage budget.
+PARITY_ROWS = 2048
+FAMILY = 4            # planted family size (members per base row)
+MUTATIONS = 3         # mutated slots per non-base member
+PAGED_BUDGET_MB = 16  # pagestore resident budget for the paging-on arm
+
+
+def _maxrss_mb() -> float:
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # linux reports KiB, macOS bytes
+    return ru / 1024.0 if sys.platform != "darwin" else ru / (1 << 20)
+
+
+def _make_chunk(rng, lo, hi, width, bases):
+    """Rows [lo, hi) of the planted-family corpus: row i belongs to
+    family i // FAMILY; non-base members mutate MUTATIONS slots of the
+    family base row. Deterministic in (seed, chunking is per-family)."""
+    import numpy as np
+
+    out = np.empty((hi - lo, width), dtype=np.uint64)
+    for i in range(lo, hi):
+        fam, member = divmod(i, FAMILY)
+        base = bases(fam)
+        row = base.copy()
+        if member:
+            mrng = np.random.default_rng(hash((fam, member)) & 0x7FFFFFFF)
+            idx = mrng.choice(width, size=MUTATIONS, replace=False)
+            row[idx] = mrng.integers(0, 1 << 62, size=MUTATIONS,
+                                     dtype=np.uint64)
+        row.sort()
+        out[i - lo] = row
+    return out
+
+
+def _cards(n):
+    """Per-row HLL cardinality stand-ins, family-correlated so the
+    band partition is non-trivial; identical in both arms."""
+    import numpy as np
+
+    fam = np.arange(n) // FAMILY
+    return (5_000.0 + 137.0 * (fam % 97)).astype(np.float64)
+
+
+def run_child(n: int, paging: bool, width: int, seed: int) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from galah_tpu.ops.bucketing import bucketed_threshold_pairs
+
+    base_cache: dict = {}
+
+    def bases(fam):
+        if fam not in base_cache:
+            if len(base_cache) > 64:
+                base_cache.clear()
+            frng = np.random.default_rng(seed * 1_000_003 + fam)
+            base_cache[fam] = frng.integers(0, 1 << 62, size=width,
+                                            dtype=np.uint64)
+        return base_cache[fam]
+
+    # Warm the pair machinery BEFORE the RSS baseline so delta_rss_mb
+    # measures the corpus + pass, not one-time import cost. The real
+    # pass runs PARITY_ROWS >= the sparse-screen crossover, whose
+    # jax/jit imports dominate a cold process's footprint — warm with
+    # a small matrix on the same side of the crossover.
+    from galah_tpu.ops.collision import sparse_screen_min_n
+
+    wn = max(8, sparse_screen_min_n()) if PARITY_ROWS >= \
+        sparse_screen_min_n() else 8
+    wrng = np.random.default_rng(1)
+    warm = wrng.integers(0, 1 << 62, size=(wn, width), dtype=np.uint64)
+    warm.sort(axis=1)
+    bucketed_threshold_pairs(warm, _cards(wn), k=21, min_ani=0.95,
+                             sketch_size=width)
+    del warm
+    rss0 = _maxrss_mb()
+    rng = np.random.default_rng(seed)
+    chunk = 1024
+    t0 = time.perf_counter()
+    page_ins = page_outs = resident = 0
+    if paging:
+        import shutil
+        import tempfile
+
+        from galah_tpu.io.pagestore import SketchPageStore
+
+        d = tempfile.mkdtemp(prefix="bench-pagestore-")
+        store = SketchPageStore(
+            d, cols=width, budget_bytes=PAGED_BUDGET_MB << 20)
+        for lo in range(0, n, chunk):
+            rows = _make_chunk(rng, lo, min(lo + chunk, n), width, bases)
+            for j in range(rows.shape[0]):
+                store.append(f"g{lo + j}", rows[j])
+        store.flush()
+        ingest_s = time.perf_counter() - t0
+        m = min(n, PARITY_ROWS)
+        from galah_tpu.io.pagestore import PagedRowView
+
+        mat = PagedRowView(store, np.arange(m))
+    else:
+        full = np.empty((n, width), dtype=np.uint64)
+        for lo in range(0, n, chunk):
+            full[lo:min(lo + chunk, n)] = _make_chunk(
+                rng, lo, min(lo + chunk, n), width, bases)
+        ingest_s = time.perf_counter() - t0
+        m = min(n, PARITY_ROWS)
+        mat = full[:m]
+
+    t1 = time.perf_counter()
+    pairs = bucketed_threshold_pairs(
+        mat, _cards(m), k=21, min_ani=0.95, sketch_size=width)
+    pair_s = time.perf_counter() - t1
+    if paging:
+        page_ins = store._c_page_ins.value
+        page_outs = store._c_page_outs.value
+        resident = store.resident_bytes
+        store.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+    digest = hashlib.sha256(json.dumps(
+        sorted((i, j, round(float(a), 12))
+               for (i, j), a in pairs.items())).encode()).hexdigest()
+    print("CHILD_JSON " + json.dumps({
+        "n": n, "paging": paging,
+        "peak_rss_mb": round(_maxrss_mb(), 1),
+        "baseline_rss_mb": round(rss0, 1),
+        "delta_rss_mb": round(_maxrss_mb() - rss0, 1),
+        "ingest_s": round(ingest_s, 2),
+        "genomes_per_sec": round(n / max(ingest_s, 1e-9), 1),
+        "pair_s": round(pair_s, 2),
+        "parity_rows": m, "n_pairs": len(pairs),
+        "pairs_digest": digest,
+        "page_ins": page_ins, "page_outs": page_outs,
+        "resident_bytes": resident,
+    }), flush=True)
+
+
+def _spawn(n, paging, width, seed, timeout):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", str(n),
+         "--paging", "on" if paging else "off",
+         "--width", str(width), "--seed", str(seed)],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env)
+    for line in proc.stdout.splitlines():
+        if line.startswith("CHILD_JSON "):
+            return json.loads(line[len("CHILD_JSON "):])
+    raise RuntimeError(f"rung n={n} paging={paging} rc={proc.returncode}: "
+                       f"{proc.stderr[-500:]}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--budget", type=float, default=480.0,
+                    help="soft wall-clock budget in seconds")
+    ap.add_argument("--rungs", default="1000,20000,100000")
+    ap.add_argument("--width", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--child", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--paging", default="off", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child is not None:
+        run_child(args.child, args.paging == "on", args.width, args.seed)
+        return 0
+
+    t0 = time.monotonic()
+    rungs = [int(x) for x in args.rungs.split(",") if x]
+    out = {"rungs": {}, "skipped": [], "parity_ok": True}
+    # Cost model: per-arm wall = fixed (imports + capped pair pass)
+    # + ingest, with only the ingest part scaling in n.
+    fixed_s, ingest_s, last_n = 20.0, 5.0, rungs[0]
+    for n in rungs:
+        est = 2 * (fixed_s + ingest_s * max(1.0, n / last_n)) * 1.5
+        rem = args.budget - (time.monotonic() - t0)
+        if est > rem:
+            out["skipped"].append(
+                {"n": n, "reason": f"est {est:.0f}s > {rem:.0f}s left"})
+            continue
+        t1 = time.monotonic()
+        off = _spawn(n, False, args.width, args.seed, timeout=rem)
+        on = _spawn(n, True, args.width, args.seed,
+                    timeout=max(args.budget - (time.monotonic() - t0),
+                                30.0))
+        arm_wall = (time.monotonic() - t1) / 2
+        ingest_s = max((off["ingest_s"] + on["ingest_s"]) / 2, 0.5)
+        fixed_s = max(arm_wall - ingest_s, 1.0)
+        last_n = n
+        parity = off["pairs_digest"] == on["pairs_digest"]
+        out["parity_ok"] = out["parity_ok"] and parity
+        ratio = (on["delta_rss_mb"] / off["delta_rss_mb"]
+                 if off["delta_rss_mb"] > 0 else None)
+        rung = {"resident": off, "paged": on, "parity": parity,
+                "delta_rss_ratio": (round(ratio, 3)
+                                    if ratio is not None else None)}
+        out["rungs"][str(n)] = rung
+        print(json.dumps({"rung": n, "parity": parity,
+                          "delta_rss_ratio": rung["delta_rss_ratio"],
+                          "paged_genomes_per_sec": on["genomes_per_sec"],
+                          "resident_genomes_per_sec":
+                              off["genomes_per_sec"]}), flush=True)
+
+    done = [int(k) for k in out["rungs"]]
+    if done:
+        big = str(max(done))
+        r = out["rungs"][big]
+        out["headline_n"] = int(big)
+        # the perf-ledger gauges (bench.pagestore_*): RSS ratio of the
+        # paged arm over all-resident, both arms' ingest rates, and
+        # the paging traffic that bought the bound
+        out["pagestore_delta_rss_ratio"] = r["delta_rss_ratio"]
+        out["pagestore_paged_genomes_per_sec"] = \
+            r["paged"]["genomes_per_sec"]
+        out["pagestore_resident_genomes_per_sec"] = \
+            r["resident"]["genomes_per_sec"]
+        out["pagestore_page_ins"] = r["paged"]["page_ins"]
+        out["pagestore_page_outs"] = r["paged"]["page_outs"]
+        out["pagestore_parity_ok"] = int(out["parity_ok"])
+    print("TIERED_JSON " + json.dumps(out), flush=True)
+    return 0 if out["parity_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

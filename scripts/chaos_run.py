@@ -122,6 +122,24 @@ OVERLAP_ENV = {"GALAH_TPU_OVERLAP": "1",
                # path and quietly passing the byte-identity gate
                "GALAH_TPU_MEGAKERNEL": "1"}
 
+#: Env for the paged iterations the cluster-overlap workload
+#: interleaves: the out-of-core sketch tier forced on (docs/memory.md)
+#: with a 1 MiB resident budget, so every page-in evicts and the kill/
+#: fault window covers the pagestore commit sites
+#: (io.atomic.write[pagestore.page], io.atomic.append[pagestore.dir] —
+#: prefix-matched by the harness's site=io.atomic fault spec).  The
+#: paged band walk is a bucketed stage-serial pass — mutually
+#: exclusive with the forced overlap (a stream cannot band a prefix) —
+#: so these iterations leave GALAH_TPU_OVERLAP at auto and gate
+#: against the SAME overlapped reference: the chaos loop doubles as a
+#: cross-engine byte-identity check.
+PAGED_ENV = {"GALAH_TPU_SKETCH_STRATEGY": "xla",
+             "GALAH_TPU_GREEDY_STRATEGY": "device",
+             "GALAH_TPU_MEGAKERNEL": "1",
+             "GALAH_TPU_HLL_BUCKETS": "1",
+             "GALAH_TPU_PAGESTORE": "1",
+             "GALAH_TPU_SKETCH_RAM_MB": "1"}
+
 
 def index_argv(index_dir: str, genomes: Optional[List[str]] = None,
                action: str = "insert", resume: bool = False,
@@ -886,7 +904,11 @@ def run_harness(iterations: int, seed: int, workdir: str,
     forced on, so kills land inside the single fused pipeline — mid
     ingest, mid speculative fragment batch, or at the quiesce point —
     and the byte-identity gate proves the overlapped engine is exactly
-    as preemption-safe as the stage-serial one."""
+    as preemption-safe as the stage-serial one.  Odd iterations swap
+    in ``PAGED_ENV`` instead: the paged bucketed band walk forced on
+    under a tiny resident budget, so the same kill/fault schedule also
+    lands inside pagestore page commits and evictions — still gated
+    byte-for-byte against the overlapped reference."""
     precluster = "finch" if overlap else "skani"
     extra_env = OVERLAP_ENV if overlap else None
     gdir = os.path.join(workdir, "genomes")
@@ -918,13 +940,16 @@ def run_harness(iterations: int, seed: int, workdir: str,
     rng.shuffle(schedule)
     failures = 0
     for i, mode in enumerate(schedule):
+        paged = overlap and i % 2 == 1
         ok, detail = run_iteration(genomes, reference, workdir, mode,
                                    seed * 1000 + i,
                                    precluster=precluster,
-                                   extra_env=extra_env)
+                                   extra_env=PAGED_ENV if paged
+                                   else extra_env)
         status = "PASS" if ok else "FAIL"
+        label = f"{mode}+paged" if paged else mode
         if verbose or not ok:
-            print(f"[{i + 1:2d}/{iterations}] {mode:<10s} {status}")
+            print(f"[{i + 1:2d}/{iterations}] {label:<16s} {status}")
             if verbose or not ok:
                 for line in detail.splitlines():
                     if not ok or line.strip().startswith(
@@ -949,7 +974,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "index-insert", "fleet"),
                     help="what to kill: a checkpointed cluster run "
                          "(default), the same run with the overlapped "
-                         "dataflow forced on (cluster-overlap), an "
+                         "dataflow forced on — odd iterations force "
+                         "the paged sketch tier instead "
+                         "(cluster-overlap), an "
                          "incremental `index insert` against a "
                          "prebuilt index, or an elastic multi-worker "
                          "`fleet run` whose workers AND scheduler get "

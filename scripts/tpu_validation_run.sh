@@ -238,6 +238,11 @@ run_stage sketch_variants 1200 python -u scripts/bench_sketch_variants.py
 # bench.py wedge and lands in its own artifact).
 run_stage ingest_variants 600 python -u scripts/bench_ingest.py \
   --variants --budget 480
+# Out-of-core sketch tier vs all-resident: peak-RSS ratio, ingest
+# rate per rung, pair-dict parity (docs/memory.md). Also runs inside
+# bench.py; same wedge-survival rationale.
+run_stage ingest_tiered 600 python -u scripts/bench_ingest_tiered.py \
+  --budget 480
 # Incremental-index service: build-once then insert-10% throughput
 # and the warm query-latency sweep (acceptance: p50 < 50 ms on CPU;
 # the TPU capture records the same numbers under the device sketch

@@ -1,0 +1,34 @@
+"""GL1007 fixture: a paged band walk that retains gathered bands.
+
+Loaded with path="galah_tpu/ops/bucketing.py" so the PAGED_MODULES
+registry arms the rule for bucketed_threshold_pairs(). Three seeded
+violations: an in-loop append of the gathered submatrix (lexical),
+a use of the gather-bound name after the loop (lexical), and a
+gather value handed to a helper chain that stores it in a module
+global (interprocedural — invisible to the lexical arm)."""
+
+_STASH = []
+
+
+def _keep_band(sub):
+    _STASH.append(sub)
+
+
+def _fold(sub, acc):
+    _keep_band(sub)
+    return len(acc)
+
+
+def _reduce(sub):
+    return sub.sum()
+
+
+def bucketed_threshold_pairs(mat, bands):
+    kept = []
+    total = 0
+    for b in bands:
+        sub = mat.band_gather(b)
+        kept.append(sub)
+        total += _reduce(sub)
+        total += _fold(mat.gather(b), kept)
+    return total, sub

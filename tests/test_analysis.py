@@ -584,7 +584,7 @@ def test_lint_run_report_carries_summary(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(report_path.read_text())
-    assert report["version"] == 9
+    assert report["version"] == 10
     assert report["run"]["subcommand"] == "lint"
     assert set(report["lint"]) == {"errors", "warnings", "notes",
                                    "suppressed", "by_family",
@@ -809,6 +809,54 @@ def test_gl1006_device_round_annotation_validation():
     src = SourceFile(path="galah_tpu/ops/x.py", text=text,
                      tree=ast.parse(text))
     assert check_pipeline_file(src) == []
+
+
+def test_gl1007_paged_fixture_fires_both_lexical_arms():
+    """The registered band-walk function may not accumulate a
+    gathered band in the loop nor reference one after it."""
+    from galah_tpu.analysis.pipeline_check import (PAGED_MODULES,
+                                                   check_pipeline_file)
+
+    path = "galah_tpu/ops/bucketing.py"
+    assert "bucketed_threshold_pairs" in PAGED_MODULES[path]
+    src = load_fixture("paged_bad.py", path=path)
+    found = check_pipeline_file(src)
+    assert [(f.code, f.line) for f in found] == \
+        [("GL1007", 31), ("GL1007", 34)]
+    # in-loop accumulation names the retainer method, the post-loop
+    # reference names the surviving binding
+    assert ".append() accumulates" in found[0].message
+    assert "referenced after" in found[1].message
+    assert all(f.symbol == "bucketed_threshold_pairs" for f in found)
+    assert all(f.severity is Severity.WARNING for f in found)
+
+
+def test_gl1007_scope_is_the_paged_registry():
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    # same source outside the registry: the rule stays dark
+    src = load_fixture("paged_bad.py", path="galah_tpu/ops/other.py")
+    assert "GL1007" not in codes(check_pipeline_file(src))
+
+
+def test_gl1007_interprocedural_arm_renders_the_retention_chain():
+    """The gather value handed to _fold() -> _keep_band() -> module
+    global is invisible lexically; the GalahIR arm reports it with
+    the full retention chain down to the storing statement."""
+    from galah_tpu.analysis.effects_check import check_effects
+    from galah_tpu.analysis.pipeline_check import check_pipeline_file
+
+    path = "galah_tpu/ops/bucketing.py"
+    src = load_fixture("paged_bad.py", path=path)
+    # the lexical arm must NOT see the helper indirection at line 33
+    assert 33 not in [f.line for f in check_pipeline_file(src)]
+    found = [f for f in check_effects({src.path: src})
+             if f.code == "GL1007"]
+    assert [(f.line, f.symbol) for f in found] == [(33, "gather")]
+    assert "retained by _fold()" in found[0].message
+    assert "_fold -> _keep_band: parameter 'sub' retained at " \
+        f"{path}:14" in found[0].message
+    assert found[0].severity is Severity.WARNING
 
 
 def test_gl10xx_family_and_suppression():

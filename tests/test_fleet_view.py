@@ -154,7 +154,8 @@ def test_rollup_accepts_old_schema_shard_reports(tmp_path):
     _write_shard_report(fleet_dir, 0, version=6)  # pre-flow-CP era
     _write_shard_report(fleet_dir, 1)             # current v9
     ru = fleet_view.rollup(fleet_dir)
-    assert sorted(ru["source"]["schema_versions"]) == [6, 9]
+    assert sorted(ru["source"]["schema_versions"]) == [
+        6, report_mod.REPORT_VERSION]
     assert ru["shards"]["0"]["report_version"] == 6
     blame = sum(c["blame_s"] for c in ru["components"].values())
     assert blame == pytest.approx(ru["fleet_wall_s"], abs=1e-6)

@@ -311,7 +311,7 @@ def _report_with_flow(tmp_path, name="run_report.json"):
 
 def test_report_v6_carries_flow_section_and_validates(tmp_path):
     rep, _ = _report_with_flow(tmp_path)
-    assert rep["version"] == 9
+    assert rep["version"] == report_mod.REPORT_VERSION
     flow = rep["flow"]
     assert flow["stages"]["pairs"]["items"] == 1
     cp = flow["critical_path"]

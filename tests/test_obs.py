@@ -402,7 +402,7 @@ def test_report_v3_carries_populated_device_costs():
         for _ in range(3):
             fn(jnp.ones((8, 8), jnp.float32))
     rep = report_mod.assemble("cluster", started_at=0.0)
-    assert rep["version"] == 9
+    assert rep["version"] == 10
     dc = rep["device_costs"]
     assert dc["profiling_enabled"] is True
     entry = dc["entries"]["test.v3_entry"]

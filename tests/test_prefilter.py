@@ -1,0 +1,226 @@
+"""Ingest prefilter (ops/prefilter.py): conservative by construction.
+
+The acceptance bar for every skip the prefilter takes is *provable
+bit-identity* — prefilter on vs off must produce the same pair cache
+and the same clustering on any corpus, including corpora planted with
+the cases it screens (byte-duplicate paths, degenerate genomes with
+no valid k-mer window). The parity test runs the real
+MinHashPreclusterer end to end both ways, on a planted-family corpus
+and on a dense single-family corpus, with and without the paged
+sketch tier underneath (docs/memory.md) — in ONE clean single-device
+subprocess: the conftest's 8-device mesh puts a multi-second
+collective dispatch under every distances() call, which is mesh
+overhead, not parity signal, and a child process runs all seven arms
+in a couple of seconds on the C pair path.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from galah_tpu.ops import prefilter
+
+
+class _G:
+    """Stub genome: just the fields the screen functions read."""
+
+    def __init__(self, codes, offsets=None):
+        self.codes = np.asarray(codes, dtype=np.uint8)
+        self.contig_offsets = np.asarray(
+            offsets if offsets is not None else [0, len(codes)],
+            dtype=np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Screen predicates
+# ---------------------------------------------------------------------------
+
+
+def test_has_valid_window_cases():
+    k = 5
+    # a clean run of k unambiguous bases -> has a window
+    assert prefilter._has_valid_window(_G([0, 1, 2, 3, 0]), k)
+    # genome shorter than k -> provably empty k-mer set
+    assert not prefilter._has_valid_window(_G([0, 1, 2]), k)
+    # every contig shorter than k, though the total is not
+    assert not prefilter._has_valid_window(
+        _G([0, 1, 2, 0, 1, 2], offsets=[0, 3, 6]), k)
+    # ambiguous bases (255) break the run below k everywhere
+    assert not prefilter._has_valid_window(
+        _G([0, 1, 255, 2, 3, 255, 0, 1]), k)
+    # ... but a k-run on either side of an N is a window
+    assert prefilter._has_valid_window(
+        _G([255, 0, 1, 2, 3, 0, 255]), k)
+    # exact-length boundary: run of exactly k counts
+    assert prefilter._has_valid_window(_G([255] + [0] * 5 + [255]), k)
+    assert not prefilter._has_valid_window(_G([255] + [0] * 4 + [255]), k)
+
+
+def test_digest_separates_content_not_paths():
+    a = _G([0, 1, 2, 3] * 10)
+    b = _G([0, 1, 2, 3] * 10)
+    c = _G([0, 1, 2, 3] * 10, offsets=[0, 20, 40])  # same codes, 2 contigs
+    assert prefilter._digest(a) == prefilter._digest(b)
+    assert prefilter._digest(a) != prefilter._digest(c)
+
+
+def test_engagement_tristate(monkeypatch):
+    monkeypatch.setenv("GALAH_TPU_PREFILTER", "0")
+    assert not prefilter.prefilter_engaged()
+    monkeypatch.setenv("GALAH_TPU_PREFILTER", "1")
+    assert prefilter.prefilter_engaged()
+    monkeypatch.setenv("GALAH_TPU_PREFILTER", "auto")
+    assert prefilter.prefilter_engaged()  # tests run single-process
+
+
+# ---------------------------------------------------------------------------
+# End-to-end parity (subprocess driver)
+# ---------------------------------------------------------------------------
+
+_PARITY_DRIVER = r"""
+import os
+import sys
+
+import numpy as np
+
+root = sys.argv[1]
+os.environ["GALAH_TPU_SKETCH_STRATEGY"] = "c"
+
+from galah_tpu.backends.minhash_backend import MinHashPreclusterer
+from galah_tpu.obs import metrics as obs_metrics
+
+BASES = np.array(list("ACGT"))
+
+
+def _write(path, seq):
+    with open(path, "w") as f:
+        f.write(">c1\n")
+        for i in range(0, len(seq), 70):
+            f.write(seq[i:i + 70] + "\n")
+
+
+def _planted_corpus(root, families=2, members=3, length=12_000, seed=11):
+    # Family corpus (test_synthetic_families.py recipe) salted with the
+    # prefilter's screen cases: a byte-duplicate of fam0_m1 under a new
+    # path, a degenerate all-N genome, and a degenerate genome whose
+    # contigs are all shorter than k.
+    rng = np.random.default_rng(seed)
+    paths = []
+    for fam in range(families):
+        base = rng.integers(0, 4, size=length)
+        for member in range(members):
+            codes = base.copy()
+            if member:
+                sites = rng.random(length) < 0.005
+                codes[sites] = (codes[sites] + rng.integers(
+                    1, 4, size=int(sites.sum()))) % 4
+            p = os.path.join(root, f"fam{fam}_m{member}.fna")
+            _write(p, "".join(BASES[codes]))
+            paths.append(p)
+    dup = os.path.join(root, "dup_of_fam0_m1.fna")
+    with open(paths[1], "rb") as src, open(dup, "wb") as dst:
+        dst.write(src.read())
+    paths.append(dup)
+    all_n = os.path.join(root, "degenerate_n.fna")
+    _write(all_n, "N" * 500)
+    paths.append(all_n)
+    shorty = os.path.join(root, "degenerate_short.fna")
+    with open(shorty, "w") as f:
+        for c in range(6):
+            f.write(f">c{c}\nACGTACGTAC\n")  # 10 bp < k=21 per contig
+    paths.append(shorty)
+    return paths
+
+
+def _dense_corpus(root, members=8, length=9_000, seed=13):
+    # One family, everything within ~99.8% ANI: the dense regime where
+    # nothing screens out except the planted duplicate.
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 4, size=length)
+    paths = []
+    for member in range(members):
+        codes = base.copy()
+        if member:
+            sites = rng.random(length) < 0.002
+            codes[sites] = (codes[sites] + rng.integers(
+                1, 4, size=int(sites.sum()))) % 4
+        p = os.path.join(root, f"dense_m{member}.fna")
+        _write(p, "".join(BASES[codes]))
+        paths.append(p)
+    dup = os.path.join(root, "dense_dup.fna")
+    with open(paths[0], "rb") as src, open(dup, "wb") as dst:
+        dst.write(src.read())
+    paths.append(dup)
+    return paths
+
+
+def _distances(paths, **env):
+    for key in ("GALAH_TPU_PREFILTER", "GALAH_TPU_PAGESTORE",
+                "GALAH_TPU_HLL_BUCKETS", "GALAH_TPU_SKETCH_RAM_MB"):
+        os.environ.pop(key, None)
+    os.environ.update(env)
+    return MinHashPreclusterer(min_ani=0.9).distances(list(paths))
+
+
+def _skipped():
+    snap = obs_metrics.snapshot().get("prefilter.skipped", {})
+    return snap.get("value", 0)
+
+
+pd = os.path.join(root, "planted")
+dd = os.path.join(root, "dense")
+os.makedirs(pd)
+os.makedirs(dd)
+planted = _planted_corpus(pd)
+dense = _dense_corpus(dd)
+
+# prefilter on/off bit-parity on both corpora, and the screens fired:
+# at least the duplicate skipped (plus both degenerates on planted).
+base_planted = _distances(planted, GALAH_TPU_PREFILTER="0")
+assert len(base_planted) > 0
+before = _skipped()
+on_planted = _distances(planted, GALAH_TPU_PREFILTER="1")
+assert on_planted == base_planted          # PairDistanceCache bit-parity
+assert _skipped() - before >= 3
+
+base_dense = _distances(dense, GALAH_TPU_PREFILTER="0")
+assert len(base_dense) > 0
+before = _skipped()
+on_dense = _distances(dense, GALAH_TPU_PREFILTER="1")
+assert on_dense == base_dense
+assert _skipped() - before >= 1
+
+# The tiered path agrees with the all-resident baseline bit for bit:
+# paged band walk (bucketed pass over the page store under a 1 MiB
+# resident budget), with and without the prefilter on top.
+# Bucketed-unpaged parity is ops/bucketing's own test surface.
+paged_env = dict(GALAH_TPU_HLL_BUCKETS="1", GALAH_TPU_PAGESTORE="1",
+                 GALAH_TPU_SKETCH_RAM_MB="1")
+paged = _distances(planted, GALAH_TPU_PREFILTER="0", **paged_env)
+assert paged == base_planted
+paged_pre = _distances(planted, GALAH_TPU_PREFILTER="1", **paged_env)
+assert paged_pre == base_planted
+
+print("PARITY_OK")
+"""
+
+
+def test_prefilter_parity_end_to_end(tmp_path):
+    """All seven parity arms in one clean child: prefilter on/off on
+    the planted and dense corpora, then the paged tier (with and
+    without the prefilter) against the all-resident baseline."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # Drop the conftest's 8-fake-device forcing: the child measures
+    # parity, and the single-device C pair path is bit-identical to
+    # the mesh path by the strategy contract (tested elsewhere).
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _PARITY_DRIVER, str(tmp_path)],
+        capture_output=True, text=True, timeout=240,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "PARITY_OK" in proc.stdout

@@ -385,7 +385,7 @@ def test_global_summary_feeds_run_report():
     from galah_tpu.obs import report as report_mod
 
     rep = report_mod.assemble("test", argv=["galah-tpu", "test"])
-    assert rep["version"] == 9
+    assert rep["version"] == report_mod.REPORT_VERSION
     assert rep["sanitizer"]["enabled"] is True
     rendered = report_mod.render(rep)
     assert "concurrency sanitizer (GalahSan):" in rendered
